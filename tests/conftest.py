@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+Mirrors the reference's test posture of exercising the full concurrency
+topology without real hardware (reference
+`packages/beacon-node/test/utils/node/beacon.ts` getDevBeaconNode spins
+multi-node topologies in-process). Real-TPU runs happen via bench.py.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,241 @@
+"""Tests for the pure-Python BLS12-381 reference implementation.
+
+Modeled on the reference's BLS coverage
+(`packages/beacon-node/test/perf/bls/bls.test.ts:37-65` verify /
+verifyMultipleSignatures shapes, and the spec-test BLS runner strategy in
+`packages/beacon-node/test/spec/`): sign/verify roundtrips, aggregation,
+batch verification incl. adversarial cases.
+"""
+
+import pytest
+
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.crypto.bls import pairing as PR
+from lodestar_tpu.crypto.bls import serdes
+from lodestar_tpu.crypto.bls.hash_to_curve import expand_message_xmd, hash_to_g2
+
+
+def _sk(i: int) -> bls.SecretKey:
+    return bls.SecretKey.from_bytes(i.to_bytes(32, "big"))
+
+
+class TestFields:
+    def test_fp2_mul_inv_roundtrip(self):
+        a = (12345678901234567890 % F.P, 998877665544332211 % F.P)
+        assert F.fp2_eq(F.fp2_mul(a, F.fp2_inv(a)), F.FP2_ONE)
+
+    def test_fp2_sqrt(self):
+        a = (17, 29)
+        sq = F.fp2_sq(a)
+        root = F.fp2_sqrt(sq)
+        assert root is not None
+        assert F.fp2_eq(F.fp2_sq(root), sq)
+
+    def test_fp6_fp12_inv(self):
+        x = (((3, 5), (7, 11), (13, 17)), ((19, 23), (29, 31), (37, 41)))
+        assert F.fp12_eq(F.fp12_mul(x, F.fp12_inv(x)), F.FP12_ONE)
+
+    def test_frobenius_is_p_power(self):
+        x = (((3, 5), (7, 11), (13, 17)), ((19, 23), (29, 31), (37, 41)))
+        assert F.fp12_eq(F.fp12_frobenius(x, 1), F.fp12_pow(x, F.P))
+
+    def test_frobenius_order_12(self):
+        x = (((3, 5), (7, 11), (13, 17)), ((19, 23), (29, 31), (37, 41)))
+        assert F.fp12_eq(F.fp12_frobenius(x, 12), x)
+
+
+class TestCurve:
+    def test_generator_order(self):
+        assert C.g1_mul_raw(C.G1_GEN, F.R) is None
+        assert C.g2_mul_raw(C.G2_GEN, F.R) is None
+
+    def test_add_double_consistency(self):
+        p2 = C.g1_double(C.G1_GEN)
+        p3a = C.g1_add(p2, C.G1_GEN)
+        p3b = C.g1_mul(C.G1_GEN, 3)
+        assert C.g1_eq(p3a, p3b)
+
+    def test_g2_add_double_consistency(self):
+        q2 = C.g2_double(C.G2_GEN)
+        q3a = C.g2_add(q2, C.G2_GEN)
+        q3b = C.g2_mul(C.G2_GEN, 3)
+        assert C.g2_eq(q3a, q3b)
+
+    def test_neg_cancels(self):
+        assert C.g1_add(C.G1_GEN, C.g1_neg(C.G1_GEN)) is None
+        assert C.g2_add(C.G2_GEN, C.g2_neg(C.G2_GEN)) is None
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        e_ab = PR.pairing(C.g1_mul(C.G1_GEN, 6), C.g2_mul(C.G2_GEN, 5))
+        e_prod = PR.pairing(C.g1_mul(C.G1_GEN, 30), C.G2_GEN)
+        assert F.fp12_eq(e_ab, e_prod)
+
+    def test_nondegenerate(self):
+        assert not F.fp12_eq(PR.pairing(C.G1_GEN, C.G2_GEN), F.FP12_ONE)
+
+    def test_inverse_product(self):
+        assert PR.pairings_are_one(
+            [(C.G1_GEN, C.G2_GEN), (C.g1_neg(C.G1_GEN), C.G2_GEN)]
+        )
+
+
+class TestSerdes:
+    def test_g1_roundtrip(self):
+        for k in (1, 2, 7, 123456789):
+            pt = C.g1_mul(C.G1_GEN, k)
+            assert C.g1_eq(serdes.g1_from_bytes(serdes.g1_to_bytes(pt)), pt)
+
+    def test_g2_roundtrip(self):
+        for k in (1, 2, 7, 123456789):
+            pt = C.g2_mul(C.G2_GEN, k)
+            assert C.g2_eq(serdes.g2_from_bytes(serdes.g2_to_bytes(pt)), pt)
+
+    def test_infinity_roundtrip(self):
+        assert serdes.g1_from_bytes(serdes.g1_to_bytes(None)) is None
+        assert serdes.g2_from_bytes(serdes.g2_to_bytes(None)) is None
+
+    def test_bad_x_rejected(self):
+        # find a small x with x^3 + 4 a quadratic non-residue (guaranteed off-curve)
+        x = next(x for x in range(2, 100) if F.fp_sqrt((x**3 + 4) % F.P) is None)
+        bad = bytearray(x.to_bytes(48, "big"))
+        bad[0] |= 0x80
+        with pytest.raises(serdes.PointDecodeError):
+            serdes.g1_from_bytes(bytes(bad))
+
+    def test_x_ge_p_rejected(self):
+        bad = bytearray(F.P.to_bytes(48, "big"))
+        bad[0] |= 0x80
+        with pytest.raises(serdes.PointDecodeError):
+            serdes.g1_from_bytes(bytes(bad))
+
+
+class TestExpandMessage:
+    def test_lengths_and_determinism(self):
+        out = expand_message_xmd(b"abc", b"QUUX-V01-CS02", 0x80)
+        assert len(out) == 0x80
+        assert out == expand_message_xmd(b"abc", b"QUUX-V01-CS02", 0x80)
+        assert out != expand_message_xmd(b"abd", b"QUUX-V01-CS02", 0x80)
+
+    def test_rfc9380_known_answer(self):
+        # RFC 9380 §K.1, SHA-256 expander, DST QUUX-V01-CS02-with-expander-SHA256-128
+        dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+        out = expand_message_xmd(b"", dst, 0x20)
+        assert out.hex() == "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+        out = expand_message_xmd(b"abc", dst, 0x20)
+        assert out.hex() == "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+
+
+class TestKnownEncodings:
+    """Canonical ZCash/blst compressed generator bytes (external interop pin)."""
+
+    def test_g1_generator_bytes(self):
+        assert serdes.g1_to_bytes(C.G1_GEN).hex() == (
+            "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+            "6c55e83ff97a1aeffb3af00adb22c6bb"
+        )
+
+    def test_g2_generator_bytes(self):
+        assert serdes.g2_to_bytes(C.G2_GEN).hex() == (
+            "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+            "334cf11213945d57e5ac7d055d042b7e024aa2b2f08f0a91260805272dc51051"
+            "c6e47ad4fa403b02b4510b647ae3d1770bac0326a805bbefd48056c8c121bdb8"
+        )
+
+
+class TestHashToG2:
+    def test_subgroup_and_determinism(self):
+        p1 = hash_to_g2(b"hello")
+        assert C.g2_in_subgroup(p1)
+        assert C.g2_eq(p1, hash_to_g2(b"hello"))
+        assert not C.g2_eq(p1, hash_to_g2(b"world"))
+
+
+class TestSecretKey:
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError):
+            bls.SecretKey.from_bytes((F.R).to_bytes(32, "big"))
+        with pytest.raises(ValueError):
+            bls.SecretKey.from_bytes((F.R + 5).to_bytes(32, "big"))
+        with pytest.raises(ValueError):
+            bls.SecretKey.from_bytes(b"\x00" * 32)
+        with pytest.raises(ValueError):
+            bls.SecretKey.from_bytes(b"\x01" * 16)
+
+    def test_max_valid(self):
+        sk = bls.SecretKey.from_bytes((F.R - 1).to_bytes(32, "big"))
+        assert sk.scalar == F.R - 1
+
+
+class TestSignVerify:
+    def test_roundtrip(self):
+        sk = _sk(42)
+        pk = bls.sk_to_pk(sk)
+        sig = bls.sign(sk, b"message")
+        assert bls.verify(pk, b"message", sig)
+
+    def test_wrong_message(self):
+        sk = _sk(42)
+        assert not bls.verify(bls.sk_to_pk(sk), b"other", bls.sign(sk, b"message"))
+
+    def test_wrong_key(self):
+        sig = bls.sign(_sk(42), b"message")
+        assert not bls.verify(bls.sk_to_pk(_sk(43)), b"message", sig)
+
+    def test_infinity_pubkey_rejected(self):
+        sig = bls.sign(_sk(42), b"m")
+        inf_pk = serdes.g1_to_bytes(None)
+        assert not bls.verify(inf_pk, b"m", sig)
+
+    def test_fast_aggregate_verify(self):
+        sks = [_sk(i) for i in range(1, 6)]
+        msg = b"sync committee root"
+        agg = bls.aggregate_signatures([bls.sign(sk, msg) for sk in sks])
+        pks = [bls.sk_to_pk(sk) for sk in sks]
+        assert bls.fast_aggregate_verify(pks, msg, agg)
+        assert not bls.fast_aggregate_verify(pks[:-1], msg, agg)
+
+    def test_aggregate_verify_distinct_messages(self):
+        sks = [_sk(i) for i in range(1, 5)]
+        msgs = [bytes([i]) * 32 for i in range(4)]
+        agg = bls.aggregate_signatures([bls.sign(sk, m) for sk, m in zip(sks, msgs)])
+        pks = [bls.sk_to_pk(sk) for sk in sks]
+        assert bls.aggregate_verify(pks, msgs, agg)
+        assert not bls.aggregate_verify(pks, msgs[::-1], agg)
+
+
+class TestBatchVerify:
+    def _sets(self, n, tamper_idx=None):
+        sets = []
+        for i in range(n):
+            sk = _sk(i + 1)
+            msg = bytes([i]) * 32
+            sig = bls.sign(sk, msg)
+            if i == tamper_idx:
+                sig = bls.sign(sk, b"tampered" + bytes(24))
+            sets.append(bls.SignatureSet(bls.sk_to_pk(sk), msg, sig))
+        return sets
+
+    def test_all_valid(self):
+        assert bls.verify_signature_sets(self._sets(8))
+
+    def test_one_invalid_fails_batch(self):
+        assert not bls.verify_signature_sets(self._sets(8, tamper_idx=3))
+
+    def test_single_set(self):
+        assert bls.verify_signature_sets(self._sets(1))
+
+    def test_empty_fails(self):
+        assert not bls.verify_signature_sets([])
+
+    def test_swapped_sigs_fail_even_unrandomized(self):
+        # sum of two valid (pk_i, m, sig_j) with swapped sigs must fail
+        sets = self._sets(2)
+        swapped = [
+            bls.SignatureSet(sets[0].pubkey, sets[0].message, sets[1].signature),
+            bls.SignatureSet(sets[1].pubkey, sets[1].message, sets[0].signature),
+        ]
+        assert bls.verify_signature_sets(swapped, randomize=True) is False
