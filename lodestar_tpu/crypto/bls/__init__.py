@@ -6,12 +6,14 @@ arithmetic, batched Miller loops) and ``lodestar_tpu.models.batch_verify``
 """
 
 from .api import (
+    G2_INFINITY,
     PointDecodeError,
     SecretKey,
     SignatureSet,
     aggregate_pubkeys,
     aggregate_signatures,
     aggregate_verify,
+    eth_fast_aggregate_verify,
     fast_aggregate_verify,
     sign,
     sk_to_pk,
@@ -20,12 +22,14 @@ from .api import (
 )
 
 __all__ = [
+    "G2_INFINITY",
     "PointDecodeError",
     "SecretKey",
     "SignatureSet",
     "aggregate_pubkeys",
     "aggregate_signatures",
     "aggregate_verify",
+    "eth_fast_aggregate_verify",
     "fast_aggregate_verify",
     "sign",
     "sk_to_pk",

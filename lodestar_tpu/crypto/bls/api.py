@@ -37,6 +37,8 @@ __all__ = [
     "aggregate_pubkeys",
     "aggregate_signatures",
     "fast_aggregate_verify",
+    "eth_fast_aggregate_verify",
+    "G2_INFINITY",
     "aggregate_verify",
     "SignatureSet",
     "verify_signature_sets",
@@ -105,6 +107,10 @@ def verify(pk: bytes, message: bytes, sig: bytes) -> bool:
 
 
 def aggregate_pubkeys(pks: list[bytes]) -> bytes:
+    if not pks:
+        # An empty aggregate would encode the G1 infinity point — an invalid
+        # pubkey per KeyValidate. Mirror aggregate_signatures and refuse.
+        raise ValueError("cannot aggregate empty pubkey list")
     pts = [_decode_pubkey(pk) for pk in pks]
     acc = None
     for pt in pts:
@@ -136,6 +142,19 @@ def fast_aggregate_verify(pks: list[bytes], message: bytes, sig: bytes) -> bool:
         return False
     h = hash_to_g2(message)
     return pairings_are_one([(g1_neg(G1_GEN), sig_pt), (agg, h)])
+
+
+G2_INFINITY = b"\xc0" + b"\x00" * 95
+
+
+def eth_fast_aggregate_verify(pks: list[bytes], message: bytes, sig: bytes) -> bool:
+    """Altair eth_fast_aggregate_verify: empty participants + infinity sig is
+    valid (sync-committee path, reference
+    `packages/state-transition/src/signatureSets` sync committee sets).
+    """
+    if not pks and sig == G2_INFINITY:
+        return True
+    return fast_aggregate_verify(pks, message, sig)
 
 
 def aggregate_verify(pks: list[bytes], messages: list[bytes], sig: bytes) -> bool:
@@ -178,15 +197,17 @@ def _random_coeff() -> int:
             return k
 
 
-def verify_signature_sets(sets: list[SignatureSet], *, randomize: bool = True) -> bool:
-    """Random-linear-combination batch verification.
+def verify_signature_sets(sets: list[SignatureSet]) -> bool:
+    """Random-linear-combination batch verification (always randomized).
 
     Checks e(-g1, sum_i r_i S_i) * prod_i e(r_i PK_i, H(m_i)) == 1 with one
     shared final exponentiation — the semantics of blst's
     verifyMultipleSignatures used by the reference worker
     (`packages/beacon-node/src/chain/bls/multithread/worker.ts:52-96`).
     The asymptotic ~2x win over one-by-one verification is the reference's
-    own bound (`chain/bls/interface.ts:8`).
+    own bound (`chain/bls/interface.ts:8`). There is deliberately no
+    way to disable the blinding coefficients: an unrandomized batch is
+    forgeable (defects in different sets can cancel).
     """
     if not sets:
         return False
@@ -199,7 +220,7 @@ def verify_signature_sets(sets: list[SignatureSet], *, randomize: bool = True) -
         return False
     if any(sig is None for _, _, sig in decoded):
         return False
-    coeffs = [1] + [_random_coeff() for _ in decoded[1:]] if randomize else [1] * len(decoded)
+    coeffs = [1] + [_random_coeff() for _ in decoded[1:]]
     sig_acc = None
     f = F.FP12_ONE
     for (pk, h, sig), r_i in zip(decoded, coeffs):

@@ -191,11 +191,6 @@ def g2_eq(p1, p2) -> bool:
     return F.fp2_eq(p1[0], p2[0]) and F.fp2_eq(p1[1], p2[1])
 
 
-def g2_clear_cofactor(pt):
-    """Map an arbitrary curve point into the order-R subgroup."""
-    return g2_mul_raw(pt, H2)
-
-
 def g1_clear_cofactor(pt):
     return g1_mul_raw(pt, H1)
 

@@ -1,17 +1,16 @@
-"""Hash-to-curve for BLS12-381 G2 (RFC 9380 structure).
+"""Hash-to-curve for BLS12-381 G2: BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_.
 
-Implements the full RFC 9380 pipeline — expand_message_xmd(SHA-256) →
-hash_to_field(Fp2) → map_to_curve → clear_cofactor — with one documented
-deviation: map_to_curve uses the Shallue–van de Woestijne map (RFC 9380
-§6.6.1), whose constants are all *derivable at runtime* from the curve
-equation, instead of the eth2 ciphersuite's SSWU-on-isogenous-curve map,
-whose 3-isogeny coefficient tables are large literal constants. Every other
-stage (domain separation, expansion, field hashing, cofactor clearing)
-matches BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_. The map is a
-deterministic encoding to the correct subgroup, so all protocol-level
-properties (uniqueness of signatures, aggregation, proofs of possession)
-hold; only cross-implementation signature bytes differ until the SSWU
-isogeny tables are added (tracked as a parity TODO).
+Implements the full RFC 9380 pipeline byte-exactly for the eth2 ciphersuite:
+expand_message_xmd(SHA-256) → hash_to_field(Fp2) → simplified-SWU on the
+3-isogenous curve E' (§6.6.3) → 3-isogeny map to the twist (Appendix E.3)
+→ effective-cofactor clearing (§8.8.2 h_eff).
+
+The isogeny coefficients and h_eff are the fixed public constants of the
+ciphersuite (RFC 9380 Appendix E.3 / §8.8.2). They are validated at import
+by a structural check: a sample point on E' must map onto the twist curve
+y^2 = x^3 + 4(u+1), which any wrong coefficient breaks. Byte-exactness is
+pinned by the RFC 9380 J.10.1 known-answer vectors in
+tests/crypto/test_bls_reference.py.
 
 Role in the system: this runs host-side per message while pairings run on
 TPU — mirroring the reference where hashToCurve happens inside blst per
@@ -23,7 +22,7 @@ from __future__ import annotations
 import hashlib
 
 from . import fields as F
-from .curve import g2_add, g2_clear_cofactor, g2_rhs
+from .curve import g2_add, g2_is_on_curve, g2_mul_raw
 from .fields import P
 
 DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
@@ -64,83 +63,182 @@ def hash_to_field_fp2(msg: bytes, count: int, dst: bytes = DST_G2):
     return out
 
 
-# --- Shallue-van de Woestijne map to the G2 twist --------------------------
-# Curve: y^2 = g(x) = x^3 + B,  B = 4(u+1), A = 0.
-
-
-_g = g2_rhs
-
-
 def _sgn0(a) -> int:
-    """RFC 9380 sgn0 for Fp2 (sign of 0 extension)."""
+    """RFC 9380 §4.1 sgn0 for Fp2 elements (lexicographic sign-of-zero)."""
     sign_0 = a[0] % 2
     zero_0 = 1 if a[0] % P == 0 else 0
     sign_1 = a[1] % 2
     return sign_0 | (zero_0 & sign_1)
 
 
-def _find_svdw_z():
-    """Search for a Z meeting the RFC 9380 §6.6.1 criteria (A=0 curve)."""
-    candidates = []
-    for c1 in range(-4, 5):
-        for c0 in range(-4, 5):
-            candidates.append((c0 % P, c1 % P))
-    for z in candidates:
-        gz = _g(z)
-        if F.fp2_is_zero(gz):
-            continue
-        three_z2 = F.fp2_mul_scalar(F.fp2_sq(z), 3)  # 3Z^2 + 4A, A=0
-        if F.fp2_is_zero(three_z2):
-            continue
-        ratio = F.fp2_neg(F.fp2_mul(three_z2, F.fp2_inv(F.fp2_mul_scalar(gz, 4))))
-        if F.fp2_legendre(ratio) != 1:
-            continue
-        g_neg_half_z = _g(F.fp2_mul(F.fp2_neg(z), F.fp2_inv((2, 0))))
-        if F.fp2_legendre(gz) == 1 or F.fp2_legendre(g_neg_half_z) == 1:
-            return z
-    raise RuntimeError("no SvdW Z found")  # pragma: no cover
+# --- Simplified SWU on the 3-isogenous curve E' (RFC 9380 §6.6.3) ----------
+# E': y^2 = x^3 + A'x + B' over Fp2, with (RFC 9380 §8.8.2):
+#   A' = 240 * I,  B' = 1012 * (1 + I),  Z = -(2 + I)
+
+_ISO_A = (0, 240)
+_ISO_B = (1012, 1012)
+_Z = ((-2) % P, (-1) % P)
+_NEG_B_OVER_A = F.fp2_neg(F.fp2_mul(_ISO_B, F.fp2_inv(_ISO_A)))
+_B_OVER_ZA = F.fp2_mul(_ISO_B, F.fp2_inv(F.fp2_mul(_Z, _ISO_A)))
 
 
-_Z = _find_svdw_z()
-_C1 = _g(_Z)  # g(Z)
-_C2 = F.fp2_mul(F.fp2_neg(_Z), F.fp2_inv((2, 0)))  # -Z/2
-_3Z2 = F.fp2_mul_scalar(F.fp2_sq(_Z), 3)
-_c3_sq = F.fp2_neg(F.fp2_mul(_C1, _3Z2))  # -g(Z)*(3Z^2)
-_C3 = F.fp2_sqrt(_c3_sq)
-assert _C3 is not None
-if _sgn0(_C3) == 1:
-    _C3 = F.fp2_neg(_C3)
-_C4 = F.fp2_neg(F.fp2_mul(F.fp2_mul_scalar(_C1, 4), F.fp2_inv(_3Z2)))  # -4g(Z)/(3Z^2)
+def _gp(x):
+    """RHS of the isogenous curve: x^3 + A'x + B'."""
+    return F.fp2_add(F.fp2_add(F.fp2_mul(F.fp2_sq(x), x), F.fp2_mul(_ISO_A, x)), _ISO_B)
 
 
-def map_to_curve_svdw(u):
-    """SvdW map Fp2 -> E'(Fp2) (twist curve point, not yet in subgroup)."""
-    tv1 = F.fp2_mul(F.fp2_sq(u), _C1)
-    tv2 = F.fp2_add(F.FP2_ONE, tv1)
-    tv1 = F.fp2_sub(F.FP2_ONE, tv1)
-    tv3 = F.fp2_mul(tv1, tv2)
-    tv3 = F.fp2_inv(tv3) if not F.fp2_is_zero(tv3) else F.FP2_ZERO  # inv0
-    tv4 = F.fp2_mul(F.fp2_mul(F.fp2_mul(u, tv1), tv3), _C3)
-    x1 = F.fp2_sub(_C2, tv4)
-    x2 = F.fp2_add(_C2, tv4)
-    x3 = F.fp2_add(_Z, F.fp2_mul(_C4, F.fp2_sq(F.fp2_mul(F.fp2_sq(tv2), tv3))))
-    if F.fp2_legendre(_g(x1)) == 1:
-        x = x1
-    elif F.fp2_legendre(_g(x2)) == 1:
-        x = x2
+def map_to_curve_sswu(u):
+    """Simplified SWU map Fp2 -> E'(Fp2) (RFC 9380 §6.6.2)."""
+    tv1 = F.fp2_mul(_Z, F.fp2_sq(u))  # Z * u^2
+    tv2 = F.fp2_add(F.fp2_sq(tv1), tv1)  # Z^2 u^4 + Z u^2
+    if F.fp2_is_zero(tv2):
+        x1 = _B_OVER_ZA  # B / (Z*A)
     else:
-        x = x3
-    y = F.fp2_sqrt(_g(x))
-    assert y is not None, "SvdW guarantees a square g(x)"
+        x1 = F.fp2_mul(_NEG_B_OVER_A, F.fp2_add(F.FP2_ONE, F.fp2_inv(tv2)))
+    gx1 = _gp(x1)
+    y1 = F.fp2_sqrt(gx1)
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x2 = F.fp2_mul(tv1, x1)  # Z * u^2 * x1
+        gx2 = _gp(x2)
+        y2 = F.fp2_sqrt(gx2)
+        assert y2 is not None, "SSWU guarantees gx1 or gx2 is square"
+        x, y = x2, y2
     if _sgn0(u) != _sgn0(y):
         y = F.fp2_neg(y)
     return (x, y)
 
 
+# --- 3-isogeny E' -> E (RFC 9380 Appendix E.3) -----------------------------
+# x = x_num(x') / x_den(x'),  y = y' * y_num(x') / y_den(x')
+# Constants below are the ciphersuite's fixed isogeny coefficients
+# (RFC 9380 E.3); each Fp2 element is written (c0, c1) for c0 + c1*I.
+
+_K1 = (  # x_num, degree 3
+    (
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+    ),
+    (
+        0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+    ),
+    (
+        0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0,
+    ),
+)
+_K2 = (  # x_den, monic degree 2: x'^2 + k21*x' + k20
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63,
+    ),
+    (
+        0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F,
+    ),
+    F.FP2_ONE,
+)
+_K3 = (  # y_num, degree 3
+    (
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+    ),
+    (
+        0,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+    ),
+    (
+        0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0,
+    ),
+)
+_K4 = (  # y_den, monic degree 3: x'^3 + k42*x'^2 + k41*x' + k40
+    (
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+    ),
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3,
+    ),
+    (
+        0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99,
+    ),
+    F.FP2_ONE,
+)
+
+
+def _poly_eval(coeffs, x):
+    """Evaluate sum_i coeffs[i] * x^i (Horner)."""
+    acc = F.FP2_ZERO
+    for c in reversed(coeffs):
+        acc = F.fp2_add(F.fp2_mul(acc, x), c)
+    return acc
+
+
+def iso_map_g2(pt):
+    """3-isogeny E'(Fp2) -> E(Fp2) (the twist). Infinity maps to infinity."""
+    if pt is None:
+        return None
+    x, y = pt
+    x_den = _poly_eval(_K2, x)
+    y_den = _poly_eval(_K4, x)
+    if F.fp2_is_zero(x_den) or F.fp2_is_zero(y_den):
+        # x' is a pole of the isogeny: the image is the point at infinity.
+        return None
+    x_out = F.fp2_mul(_poly_eval(_K1, x), F.fp2_inv(x_den))
+    y_out = F.fp2_mul(y, F.fp2_mul(_poly_eval(_K3, x), F.fp2_inv(y_den)))
+    return (x_out, y_out)
+
+
+# Effective cofactor for G2 cofactor clearing (RFC 9380 §8.8.2). NOT the
+# actual curve cofactor h2 — the ciphersuite fixes this specific scalar so
+# all implementations produce identical points (it encodes the
+# Budroni-Pintore ψ-based fast clearing as a plain scalar).
+H_EFF = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
+
+
+def clear_cofactor_g2(pt):
+    """h_eff * P (RFC 9380 §7 clear_cofactor for the BLS12381G2 suites)."""
+    return g2_mul_raw(pt, H_EFF)
+
+
+# --- import-time structural validation of the isogeny constants ------------
+# Find a deterministic sample point on E' and check its image lies on the
+# twist; any wrong k-coefficient breaks this (byte-exactness is pinned by
+# the RFC 9380 J.10.1 KATs in tests).
+def _selfcheck() -> None:
+    for k in range(1, 64):
+        x = (k, 1)
+        y = F.fp2_sqrt(_gp(x))
+        if y is not None:
+            img = iso_map_g2((x, y))
+            assert img is not None and g2_is_on_curve(img), "isogeny constants invalid"
+            return
+    raise RuntimeError("no sample point found on isogenous curve")  # pragma: no cover
+
+
+_selfcheck()
+
+
+def map_to_curve_g2(u):
+    """map_to_curve for the eth2 suite: SSWU on E' then 3-isogeny to E."""
+    return iso_map_g2(map_to_curve_sswu(u))
+
+
 def hash_to_g2(msg: bytes, dst: bytes = DST_G2):
-    """hash_to_curve (RO variant): two map evaluations + cofactor clearing."""
+    """hash_to_curve RO variant (RFC 9380 §3): eth2-byte-exact G2 hashing."""
     u0, u1 = hash_to_field_fp2(msg, 2, dst)
-    q = g2_add(map_to_curve_svdw(u0), map_to_curve_svdw(u1))
-    # cofactor clearing guarantees subgroup membership (tested in
-    # tests/crypto: hash outputs satisfy g2_in_subgroup)
-    return g2_clear_cofactor(q)
+    q = g2_add(map_to_curve_g2(u0), map_to_curve_g2(u1))
+    return clear_cofactor_g2(q)

@@ -153,6 +153,46 @@ class TestHashToG2:
         assert C.g2_eq(p1, hash_to_g2(b"hello"))
         assert not C.g2_eq(p1, hash_to_g2(b"world"))
 
+    # RFC 9380 Appendix J.10.1 — BLS12381G2_XMD:SHA-256_SSWU_RO_ suite
+    # known-answer vectors. Passing these pins the whole pipeline
+    # (expand_message → hash_to_field → SSWU → isogeny → h_eff clearing)
+    # bit-for-bit to the eth2 ciphersuite used by blst in the reference
+    # (`packages/beacon-node/src/chain/bls/maybeBatch.ts:18`).
+    _RFC_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+    @pytest.mark.parametrize(
+        "msg,px0,px1,py0,py1",
+        [
+            (
+                b"",
+                "0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a",
+                "05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d",
+                "0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92",
+                "12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6",
+            ),
+            (
+                b"abc",
+                "02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbec7780ccc7954725f4168aff2787776e6",
+                "139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4ca3a230ed250fbe3a2acf73a41177fd8",
+                "1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe244aeb197642555a0645fb87bf7466b2ba48",
+                "00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49ac1e1ce70dd94a733534f106d4cec0eddd16",
+            ),
+            (
+                b"abcdef0123456789",
+                "121982811d2491fde9ba7ed31ef9ca474f0e1501297f68c298e9f4c0028add35aea8bb83d53c08cfc007c1e005723cd0",
+                "190d119345b94fbd15497bcba94ecf7db2cbfd1e1fe7da034d26cbba169fb3968288b3fafb265f9ebd380512a71c3f2c",
+                "05571a0f8d3c08d094576981f4a3b8eda0a8e771fcdcc8ecceaf1356a6acf17574518acb506e435b639353c2e14827c8",
+                "0bb5e7572275c567462d91807de765611490205a941a5a6af3b1691bfe596c31225d3aabdf15faff860cb4ef17c7c3be",
+            ),
+        ],
+    )
+    def test_rfc9380_g2_known_answer(self, msg, px0, px1, py0, py1):
+        p = hash_to_g2(msg, self._RFC_DST)
+        assert "%096x" % p[0][0] == px0
+        assert "%096x" % p[0][1] == px1
+        assert "%096x" % p[1][0] == py0
+        assert "%096x" % p[1][1] == py1
+
 
 class TestSecretKey:
     def test_out_of_range_rejected(self):
@@ -231,11 +271,31 @@ class TestBatchVerify:
     def test_empty_fails(self):
         assert not bls.verify_signature_sets([])
 
-    def test_swapped_sigs_fail_even_unrandomized(self):
+    def test_swapped_sigs_fail(self):
         # sum of two valid (pk_i, m, sig_j) with swapped sigs must fail
         sets = self._sets(2)
         swapped = [
             bls.SignatureSet(sets[0].pubkey, sets[0].message, sets[1].signature),
             bls.SignatureSet(sets[1].pubkey, sets[1].message, sets[0].signature),
         ]
-        assert bls.verify_signature_sets(swapped, randomize=True) is False
+        assert bls.verify_signature_sets(swapped) is False
+
+
+class TestEthAggregateSemantics:
+    def test_empty_pubkey_aggregate_rejected(self):
+        with pytest.raises(ValueError):
+            bls.aggregate_pubkeys([])
+
+    def test_eth_fast_aggregate_verify_empty_with_infinity(self):
+        assert bls.eth_fast_aggregate_verify([], b"\x00" * 32, bls.G2_INFINITY)
+
+    def test_eth_fast_aggregate_verify_empty_with_real_sig_fails(self):
+        sig = bls.sign(_sk(1), b"m")
+        assert not bls.eth_fast_aggregate_verify([], b"m", sig)
+
+    def test_eth_fast_aggregate_verify_nonempty_matches_ietf(self):
+        sks = [_sk(i) for i in range(1, 4)]
+        msg = b"sync committee root"
+        agg = bls.aggregate_signatures([bls.sign(sk, msg) for sk in sks])
+        pks = [bls.sk_to_pk(sk) for sk in sks]
+        assert bls.eth_fast_aggregate_verify(pks, msg, agg)
