@@ -1,0 +1,71 @@
+"""Benchmark entrypoint (driver-run on real TPU hardware).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Current headline: batched SHA-256 merkleization throughput (BASELINE
+config 4 — the `hashTreeRoot(BeaconState)` hot loop, reference
+`packages/state-transition/src/stateTransition.ts:100` via
+`@chainsafe/persistent-merkle-tree` + as-sha256). vs_baseline is the ratio
+against the host hashlib path measured in the same run — the stand-in for
+the reference's WASM as-sha256 single-thread hasher.
+
+When the BLS device pipeline lands this switches to aggregate sigs/sec
+(north-star metric, BASELINE config 1/2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+import numpy as np
+
+
+def _bench_merkle(depth: int = 20) -> dict:
+    import jax
+
+    from lodestar_tpu.ops import sha256 as S
+
+    n = 1 << depth
+    rng = np.random.default_rng(0)
+    chunks_np = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint32)
+    chunks = jax.device_put(chunks_np)
+
+    # warmup/compile all level shapes
+    root = S.merkle_root_device(chunks)
+    root.block_until_ready()
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        root = S.merkle_root_device(chunks)
+    root.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    n_hashes = n - 1  # pair-hashes in a complete binary tree
+    device_rate = n_hashes / dt
+
+    # host baseline: hashlib pair-hash rate on a sample, extrapolated
+    sample = 1 << 14
+    data = chunks_np[: 2 * sample].astype(">u4").tobytes()
+    t0 = time.perf_counter()
+    for i in range(sample):
+        hashlib.sha256(data[i * 64 : (i + 1) * 64]).digest()
+    cpu_dt = time.perf_counter() - t0
+    cpu_rate = sample / cpu_dt
+
+    return {
+        "metric": "merkle_sha256_pair_hashes_per_sec",
+        "value": round(device_rate),
+        "unit": "hashes/s",
+        "vs_baseline": round(device_rate / cpu_rate, 2),
+    }
+
+
+def main() -> None:
+    result = _bench_merkle()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,199 @@
+"""Batched SHA-256 merkle hashing on TPU (JAX).
+
+The device counterpart of `@chainsafe/as-sha256`'s WASM hot loop — the
+hasher inside persistent-merkle-tree that dominates `hashTreeRoot`
+(reference `packages/state-transition/src/stateTransition.ts:100`,
+`@chainsafe/persistent-merkle-tree` level hasher, perf pinned by
+`packages/state-transition/test/perf/hashing.test.ts`).
+
+Design (tpu-first, not a port):
+
+* One SHA-256 *compression* is 64 rounds of 32-bit scalar ops — useless for
+  the MXU but perfectly lane-parallel on the VPU. We therefore never hash
+  one message at a time: every public entry point takes a **batch** axis N
+  and runs all N compressions in lockstep as (N,)-vector uint32 ops. XLA
+  fuses the whole 64-round unrolled chain into a handful of elementwise
+  kernels over HBM-resident arrays.
+* Merkle hashing of a level = hashing N 64-byte messages (left||right),
+  each exactly one data block plus one *constant* padding block, so a level
+  costs 2 compressions with the second one's schedule partially constant.
+* Round constants and IV are derived at import (frac of cbrt/sqrt of the
+  first primes, FIPS 180-4 §4.2.2) and pinned by a known-digest assert.
+
+Host-side fallbacks for small inputs live in `lodestar_tpu.ssz.hash` — a
+single 64-byte hash is ~1000x cheaper on CPU than a device round trip, the
+same asymmetry the reference manages between inline as-sha256 calls and
+worker offload.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "IV",
+    "K",
+    "sha256_compress",
+    "hash_pairs",
+    "digest_64bytes_batch",
+    "merkle_level",
+    "merkle_root_device",
+]
+
+
+def _icbrt(n: int) -> int:
+    """Integer cube root by Newton iteration."""
+    if n == 0:
+        return 0
+    x = 1 << ((n.bit_length() + 2) // 3)
+    while True:
+        y = (2 * x + n // (x * x)) // 3
+        if y >= x:
+            return x
+        x = y
+
+
+def _first_primes(count: int) -> list[int]:
+    primes, n = [], 2
+    while len(primes) < count:
+        if all(n % p for p in primes if p * p <= n):
+            primes.append(n)
+        n += 1
+    return primes
+
+
+_PRIMES = _first_primes(64)
+# IV[i] = floor(frac(sqrt(p_i)) * 2^32); K[t] = floor(frac(cbrt(p_t)) * 2^32)
+IV = tuple(math.isqrt(p << 64) & 0xFFFFFFFF for p in _PRIMES[:8])
+K = tuple(_icbrt(p << 96) & 0xFFFFFFFF for p in _PRIMES)
+
+# FIPS 180-4 known-answer pin for the derived constants (checked end-to-end
+# against hashlib below once the compression function is defined).
+assert IV[0] == 0x6A09E667 and K[0] == 0x428A2F98 and K[63] == 0xC67178F2
+
+
+def _rotr(x, r: int):
+    return (x >> r) | (x << (32 - r))
+
+
+def sha256_compress(state, block):
+    """One SHA-256 compression over a batch.
+
+    state: (N, 8) uint32; block: (N, 16) uint32 (big-endian words).
+    Returns (N, 8) uint32.
+
+    The 64 rounds run as a `lax.fori_loop` with an unroll factor rather
+    than fully flattened Python loops: merkleization jits one program per
+    tree level, and a fully-unrolled compression (~2.5k HLO ops) times the
+    tree depth times the SPMD partitioner made compile times explode. The
+    rolled form keeps every level's graph small while the unroll factor
+    retains intra-block fusion. (A Pallas kernel is the planned endgame
+    for this op — see pallas notes in bench history.)
+    """
+    n = block.shape[0]
+    k_arr = jnp.asarray(K, dtype=jnp.uint32)
+
+    # message schedule: w[t] for t in [0, 64), layout (64, N) so each round
+    # reads one contiguous row
+    w0 = jnp.transpose(block)  # (16, N)
+    w_full = jnp.concatenate([w0, jnp.zeros((48, n), dtype=jnp.uint32)], axis=0)
+
+    def sched_body(i, w):
+        t = i + 16
+        w15 = jax.lax.dynamic_index_in_dim(w, t - 15, axis=0, keepdims=False)
+        w2 = jax.lax.dynamic_index_in_dim(w, t - 2, axis=0, keepdims=False)
+        w16 = jax.lax.dynamic_index_in_dim(w, t - 16, axis=0, keepdims=False)
+        w7 = jax.lax.dynamic_index_in_dim(w, t - 7, axis=0, keepdims=False)
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        return jax.lax.dynamic_update_index_in_dim(w, w16 + s0 + w7 + s1, t, axis=0)
+
+    w_full = jax.lax.fori_loop(0, 48, sched_body, w_full, unroll=8)
+
+    def round_body(t, carry):
+        a, b, c, d, e, f, g, h = carry
+        wt = jax.lax.dynamic_index_in_dim(w_full, t, axis=0, keepdims=False)
+        kt = jax.lax.dynamic_index_in_dim(k_arr, t, axis=0, keepdims=False)
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+
+    init = tuple(state[:, i] for i in range(8))
+    out = jax.lax.fori_loop(0, 64, round_body, init, unroll=8)
+    return state + jnp.stack(out, axis=1)
+
+
+def _iv_batch(n):
+    return jnp.broadcast_to(jnp.asarray(IV, dtype=jnp.uint32), (n, 8))
+
+
+# Constant second block: padding for a 64-byte message (0x80 marker, then
+# zeros, then the 64-bit bit-length 512).
+_PAD_64 = (0x80000000,) + (0,) * 14 + (512,)
+
+
+def digest_64bytes_batch(blocks):
+    """SHA-256 digests of N 64-byte messages: (N, 16) uint32 -> (N, 8) uint32."""
+    n = blocks.shape[0]
+    mid = sha256_compress(_iv_batch(n), blocks)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD_64, dtype=jnp.uint32), (n, 16))
+    return sha256_compress(mid, pad)
+
+
+def hash_pairs(nodes):
+    """Hash adjacent node pairs: (2N, 8) uint32 -> (N, 8) uint32.
+
+    The merkle level primitive: node[2i] || node[2i+1] is one 64-byte
+    message per output node.
+    """
+    return digest_64bytes_batch(nodes.reshape(-1, 16))
+
+
+merkle_level = jax.jit(hash_pairs)
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _merkle_root_fixed(chunks, depth: int):
+    """Root of a complete tree of 2^depth chunks: (2^depth, 8) -> (8,)."""
+    level = chunks
+    for _ in range(depth):
+        level = hash_pairs(level)
+    return level[0]
+
+
+def merkle_root_device(chunks) -> jax.Array:
+    """Merkle root of a power-of-two batch of 32-byte chunks on device.
+
+    chunks: (N, 8) uint32 with N a power of two. Each level is one fused
+    batched double-compression; the whole tree is a single jitted program
+    per depth (compile-cached).
+    """
+    n = chunks.shape[0]
+    if n & (n - 1):
+        raise ValueError("chunk count must be a power of two")
+    return _merkle_root_fixed(chunks, depth=n.bit_length() - 1)
+
+
+def words_from_bytes(data: bytes) -> np.ndarray:
+    """Big-endian uint32 view of 32-byte-aligned data: (len/32, 8)."""
+    if len(data) % 32:
+        raise ValueError("data must be a multiple of 32 bytes")
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(-1, 8)
+
+
+def bytes_from_words(words) -> bytes:
+    """Inverse of words_from_bytes."""
+    return np.asarray(words, dtype=np.uint32).astype(">u4").tobytes()
+
+
+# The end-to-end pin against hashlib lives in tests/ops/test_sha256.py
+# (an import-time device compile would defeat the lazy-import design in
+# ssz/hash.py and add an import-failure mode on JAX-less hosts).

@@ -1,0 +1,72 @@
+"""Hashing backend for SSZ merkleization: CPU for small levels, TPU batches
+for large ones.
+
+This is the swap point the SURVEY identifies as seam #2 (the
+persistent-merkle-tree `hash(left,right)` level function, reference
+`packages/state-transition/src/stateTransition.ts:100` hot loop). The
+policy mirrors the reference's inline-vs-worker asymmetry: a single 64-byte
+digest is far cheaper on host than a device round trip, so only levels with
+at least `DEVICE_MIN_PAIRS` pairs ship to the device
+(cf. SURVEY §7 hard part #4).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+# Lazy import keeps pure-host consumers (db, serdes) from paying JAX startup.
+_sha256_ops = None
+
+# Below this many pairs a level is hashed with hashlib; at or above it, the
+# batched device kernel wins (tunable for the deployment's interconnect).
+DEVICE_MIN_PAIRS = int(os.environ.get("LODESTAR_TPU_HASH_MIN_PAIRS", "2048"))
+
+
+def _ops():
+    global _sha256_ops
+    if _sha256_ops is None:
+        from lodestar_tpu.ops import sha256 as _mod
+
+        _sha256_ops = _mod
+    return _sha256_ops
+
+
+def hash_nodes_cpu(data: np.ndarray) -> np.ndarray:
+    """Hash adjacent 32-byte node pairs on host. data: (2N, 32) uint8."""
+    n = data.shape[0] // 2
+    flat = data.reshape(n, 64)
+    out = np.empty((n, 32), dtype=np.uint8)
+    for i in range(n):
+        out[i] = np.frombuffer(hashlib.sha256(flat[i].tobytes()).digest(), dtype=np.uint8)
+    return out
+
+
+def hash_nodes_device(data: np.ndarray) -> np.ndarray:
+    """Hash adjacent 32-byte node pairs on the accelerator. data: (2N, 32) uint8."""
+    ops = _ops()
+    out_words = np.asarray(ops.merkle_level(ops.words_from_bytes(data.tobytes())))
+    return np.frombuffer(ops.bytes_from_words(out_words), dtype=np.uint8).reshape(-1, 32)
+
+
+def hash_nodes(data: np.ndarray) -> np.ndarray:
+    """Hash adjacent 32-byte node pairs, auto-selecting backend by batch size."""
+    if data.shape[0] // 2 >= DEVICE_MIN_PAIRS:
+        return hash_nodes_device(data)
+    return hash_nodes_cpu(data)
+
+
+def sha256_digest(data: bytes) -> bytes:
+    """Single host-side digest (gossip ids, shuffling seeds, small objects)."""
+    return hashlib.sha256(data).digest()
+
+
+# Zero-subtree hash ladder: ZERO_HASHES[d] is the root of a depth-d tree of
+# zero chunks. Lets merkleize() handle huge list limits without hashing
+# virtual zeros (same trick as persistent-merkle-tree's zeroNode cache).
+_MAX_DEPTH = 64
+ZERO_HASHES: list[bytes] = [b"\x00" * 32]
+for _ in range(_MAX_DEPTH):
+    ZERO_HASHES.append(hashlib.sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]).digest())

@@ -1,14 +1,22 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's test posture of exercising the full concurrency
 topology without real hardware (reference
 `packages/beacon-node/test/utils/node/beacon.ts` getDevBeaconNode spins
 multi-node topologies in-process). Real-TPU runs happen via bench.py.
+
+The harness environment pins JAX_PLATFORMS to the axon TPU plugin at
+interpreter startup (sitecustomize), so the env var alone is not enough —
+we override the platform list through jax.config after import, which takes
+effect because backends initialize lazily.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

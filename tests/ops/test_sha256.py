@@ -1,0 +1,51 @@
+"""Differential tests for the batched SHA-256 device kernel vs hashlib.
+
+Mirrors the reference's strategy of pinning the WASM as-sha256 hasher
+against node's crypto (`@chainsafe/as-sha256` test suite) — here the JAX
+kernel is pinned against hashlib on every shape the merkle layer uses.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.ops import sha256 as S
+
+
+def _rand_bytes(n: int, seed: int = 0) -> bytes:
+    return np.random.default_rng(seed).integers(0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+class TestCompression:
+    def test_single_64byte_message(self):
+        msg = bytes(range(64))
+        got = S.bytes_from_words(np.asarray(S.digest_64bytes_batch(S.words_from_bytes(msg).reshape(1, 16))))
+        assert got == hashlib.sha256(msg).digest()
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 17, 256])
+    def test_batch_matches_hashlib(self, n):
+        data = _rand_bytes(64 * n, seed=n)
+        out = S.bytes_from_words(np.asarray(S.hash_pairs(S.words_from_bytes(data))))
+        expect = b"".join(hashlib.sha256(data[i * 64 : (i + 1) * 64]).digest() for i in range(n))
+        assert out == expect
+
+
+class TestMerkleRoot:
+    @pytest.mark.parametrize("depth", [0, 1, 3, 6])
+    def test_root_matches_naive(self, depth):
+        n = 1 << depth
+        data = _rand_bytes(32 * n, seed=depth)
+        got = S.bytes_from_words(np.asarray(S.merkle_root_device(S.words_from_bytes(data))).reshape(1, 8))
+        level = [data[i * 32 : (i + 1) * 32] for i in range(n)]
+        while len(level) > 1:
+            level = [hashlib.sha256(level[i] + level[i + 1]).digest() for i in range(0, len(level), 2)]
+        assert got == level[0]
+
+    def test_non_pow2_rejected(self):
+        with pytest.raises(ValueError):
+            S.merkle_root_device(S.words_from_bytes(_rand_bytes(32 * 3)))
+
+    def test_word_roundtrip(self):
+        data = _rand_bytes(96)
+        assert S.bytes_from_words(S.words_from_bytes(data)) == data
