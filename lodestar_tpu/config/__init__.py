@@ -1,0 +1,228 @@
+"""Runtime chain configuration: fork schedule, domains, networks.
+
+Counterpart of the reference `packages/config/src`
+(`beaconConfig.ts:17,25` createChainForkConfig/createBeaconConfig,
+`chainConfig/` value tables, `networks.ts`). A ChainConfig is runtime data
+(fork epochs, genesis parameters); the preset remains a separate
+compile-frozen value (see lodestar_tpu.params).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field, replace
+from functools import lru_cache
+
+from lodestar_tpu.params import FAR_FUTURE_EPOCH
+
+__all__ = [
+    "ChainConfig",
+    "ForkInfo",
+    "BeaconConfig",
+    "mainnet_chain_config",
+    "minimal_chain_config",
+    "create_beacon_config",
+    "compute_fork_data_root",
+    "compute_domain",
+    "compute_signing_root",
+    "NETWORKS",
+]
+
+FORK_ORDER = ("phase0", "altair", "bellatrix", "capella", "deneb")
+
+
+@dataclass(frozen=True)
+class ChainConfig:
+    """Spec runtime config values (reference `chainConfig/types.ts`)."""
+
+    PRESET_BASE: str = "mainnet"
+    CONFIG_NAME: str = "mainnet"
+    # genesis
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: int = 16384
+    MIN_GENESIS_TIME: int = 1606824000
+    GENESIS_FORK_VERSION: bytes = bytes.fromhex("00000000")
+    GENESIS_DELAY: int = 604800
+    # forks
+    ALTAIR_FORK_VERSION: bytes = bytes.fromhex("01000000")
+    ALTAIR_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    BELLATRIX_FORK_VERSION: bytes = bytes.fromhex("02000000")
+    BELLATRIX_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    CAPELLA_FORK_VERSION: bytes = bytes.fromhex("03000000")
+    CAPELLA_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    DENEB_FORK_VERSION: bytes = bytes.fromhex("04000000")
+    DENEB_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    # merge
+    TERMINAL_TOTAL_DIFFICULTY: int = 2**256 - 2**10
+    TERMINAL_BLOCK_HASH: bytes = b"\x00" * 32
+    TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH: int = FAR_FUTURE_EPOCH
+    # time
+    SECONDS_PER_SLOT: int = 12
+    SECONDS_PER_ETH1_BLOCK: int = 14
+    ETH1_FOLLOW_DISTANCE: int = 2048
+    # validator cycle
+    EJECTION_BALANCE: int = 16_000_000_000
+    MIN_PER_EPOCH_CHURN_LIMIT: int = 4
+    CHURN_LIMIT_QUOTIENT: int = 65536
+    INACTIVITY_SCORE_BIAS: int = 4
+    INACTIVITY_SCORE_RECOVERY_RATE: int = 16
+    PROPOSER_SCORE_BOOST: int = 40
+    # deposit contract
+    DEPOSIT_CHAIN_ID: int = 1
+    DEPOSIT_NETWORK_ID: int = 1
+    DEPOSIT_CONTRACT_ADDRESS: bytes = bytes(20)
+
+    def replace(self, **overrides) -> "ChainConfig":
+        return replace(self, **overrides)
+
+    def fork_version(self, fork: str) -> bytes:
+        if fork == "phase0":
+            return self.GENESIS_FORK_VERSION
+        return getattr(self, f"{fork.upper()}_FORK_VERSION")
+
+    def fork_epoch(self, fork: str) -> int:
+        if fork == "phase0":
+            return 0
+        return getattr(self, f"{fork.upper()}_FORK_EPOCH")
+
+
+@dataclass(frozen=True)
+class ForkInfo:
+    name: str
+    epoch: int
+    version: bytes
+    prev_version: bytes
+    prev_fork_name: str
+
+
+def _fork_schedule(cfg: ChainConfig) -> tuple[ForkInfo, ...]:
+    out = []
+    prev_version = cfg.GENESIS_FORK_VERSION
+    prev_name = "phase0"
+    for name in FORK_ORDER:
+        epoch = cfg.fork_epoch(name)
+        version = cfg.fork_version(name)
+        out.append(ForkInfo(name, epoch, version, prev_version, prev_name))
+        prev_version, prev_name = version, name
+    return tuple(out)
+
+
+def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    """hash_tree_root(ForkData) — 2-leaf merkle (spec compute_fork_data_root)."""
+    leaf0 = current_version.ljust(32, b"\x00")
+    return hashlib.sha256(leaf0 + genesis_validators_root).digest()
+
+
+def compute_domain(
+    domain_type: bytes, fork_version: bytes, genesis_validators_root: bytes
+) -> bytes:
+    return domain_type + compute_fork_data_root(fork_version, genesis_validators_root)[:28]
+
+
+def compute_signing_root(ssz_type, value, domain: bytes) -> bytes:
+    """hash_tree_root(SigningData) (spec compute_signing_root)."""
+    object_root = ssz_type.hash_tree_root(value)
+    return hashlib.sha256(object_root + domain).digest()
+
+
+# Module-level caches keyed on pure inputs: instance-method lru_cache would
+# pin every BeaconConfig (and its fork schedule) in a class-global cache.
+@lru_cache(maxsize=512)
+def _cached_domain(domain_type: bytes, fork_version: bytes, gvr: bytes) -> bytes:
+    return compute_domain(domain_type, fork_version, gvr)
+
+
+@lru_cache(maxsize=128)
+def _cached_fork_digest(fork_version: bytes, gvr: bytes) -> bytes:
+    return compute_fork_data_root(fork_version, gvr)[:4]
+
+
+@dataclass(frozen=True)
+class BeaconConfig:
+    """ChainConfig bound to a genesis_validators_root with cached domains
+    (reference `beaconConfig.ts:25` createBeaconConfig + forkDigest caches)."""
+
+    chain: ChainConfig
+    genesis_validators_root: bytes
+    forks: tuple[ForkInfo, ...] = field(default_factory=tuple)
+
+    def fork_name_at_epoch(self, epoch: int) -> str:
+        name = "phase0"
+        for f in self.forks:
+            if epoch >= f.epoch:
+                name = f.name
+        return name
+
+    def fork_info_at_epoch(self, epoch: int) -> ForkInfo:
+        info = self.forks[0]
+        for f in self.forks:
+            if epoch >= f.epoch:
+                info = f
+        return info
+
+    def fork_name_at_slot(self, slot: int, slots_per_epoch: int) -> str:
+        return self.fork_name_at_epoch(slot // slots_per_epoch)
+
+    def fork_digest(self, fork_name: str) -> bytes:
+        """4-byte digest for gossip topics / ENR (spec compute_fork_digest)."""
+        version = self.chain.fork_version(fork_name)
+        return _cached_fork_digest(version, self.genesis_validators_root)
+
+    def get_domain_by_version(self, domain_type: bytes, fork_version: bytes) -> bytes:
+        return _cached_domain(domain_type, fork_version, self.genesis_validators_root)
+
+    def get_domain(self, domain_type: bytes, epoch: int) -> bytes:
+        """Domain for signing at an epoch, using that epoch's fork version
+        (spec get_domain with state fork resolved from the schedule)."""
+        return self.get_domain_by_version(
+            domain_type, self.fork_info_at_epoch(epoch).version
+        )
+
+
+def create_beacon_config(chain: ChainConfig, genesis_validators_root: bytes) -> BeaconConfig:
+    return BeaconConfig(
+        chain=chain,
+        genesis_validators_root=genesis_validators_root,
+        forks=_fork_schedule(chain),
+    )
+
+
+def mainnet_chain_config() -> ChainConfig:
+    """Ethereum mainnet (reference `networks/mainnet.ts`)."""
+    return ChainConfig(
+        PRESET_BASE="mainnet",
+        CONFIG_NAME="mainnet",
+        ALTAIR_FORK_EPOCH=74240,
+        BELLATRIX_FORK_EPOCH=144896,
+        CAPELLA_FORK_EPOCH=194048,
+        TERMINAL_TOTAL_DIFFICULTY=58_750_000_000_000_000_000_000,
+        DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex("00000000219ab540356cbb839cbe05303d7705fa"),
+    )
+
+
+def minimal_chain_config() -> ChainConfig:
+    """Minimal-preset dev config (all forks at genesis, fast slots)."""
+    return ChainConfig(
+        PRESET_BASE="minimal",
+        CONFIG_NAME="minimal",
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=64,
+        MIN_GENESIS_TIME=1578009600,
+        GENESIS_FORK_VERSION=bytes.fromhex("00000001"),
+        ALTAIR_FORK_VERSION=bytes.fromhex("01000001"),
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("02000001"),
+        BELLATRIX_FORK_EPOCH=0,
+        CAPELLA_FORK_VERSION=bytes.fromhex("03000001"),
+        CAPELLA_FORK_EPOCH=0,
+        DENEB_FORK_VERSION=bytes.fromhex("04000001"),
+        GENESIS_DELAY=300,
+        SECONDS_PER_SLOT=6,
+        ETH1_FOLLOW_DISTANCE=16,
+        DEPOSIT_CHAIN_ID=5,
+        DEPOSIT_NETWORK_ID=5,
+    )
+
+
+NETWORKS = {
+    "mainnet": mainnet_chain_config,
+    "minimal": minimal_chain_config,
+}

@@ -1,0 +1,120 @@
+"""Cross-cutting helpers: retry, sleep, byte utils, math.
+
+Counterpart of the reference `packages/utils/src` (sleep.ts, retry.ts,
+bytes.ts, math.ts). Merkle-branch verification lives in
+`lodestar_tpu.ssz.merkle.verify_merkle_branch` (reference
+`utils/src/verifyMerkleBranch.ts`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, TypeVar
+
+T = TypeVar("T")
+
+__all__ = [
+    "sleep",
+    "retry",
+    "retry_sync",
+    "bytes_to_int",
+    "int_to_bytes",
+    "to_hex",
+    "from_hex",
+    "xor_bytes",
+    "int_div_ceil",
+    "bit_length",
+    "ErrorAborted",
+    "TimeoutError_",
+]
+
+
+class ErrorAborted(Exception):
+    """Operation cancelled by an abort signal (reference utils/errors.ts)."""
+
+
+TimeoutError_ = asyncio.TimeoutError
+
+
+async def sleep(seconds: float) -> None:
+    await asyncio.sleep(seconds)
+
+
+async def retry(
+    fn: Callable[[], Awaitable[T]],
+    *,
+    retries: int = 3,
+    retry_delay: float = 0.0,
+    should_retry: Callable[[Exception], bool] | None = None,
+) -> T:
+    """Async retry with fixed delay (reference `utils/src/retry.ts`).
+
+    Only `Exception` is retried: cancellation (CancelledError) and
+    KeyboardInterrupt propagate immediately.
+    """
+    if retries < 1:
+        raise ValueError("retries must be >= 1")
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            return await fn()
+        except Exception as e:
+            if should_retry is not None and not should_retry(e):
+                raise
+            last = e
+            if attempt < retries - 1 and retry_delay:
+                await asyncio.sleep(retry_delay)
+    assert last is not None
+    raise last
+
+
+def retry_sync(
+    fn: Callable[[], T],
+    *,
+    retries: int = 3,
+    retry_delay: float = 0.0,
+    should_retry: Callable[[Exception], bool] | None = None,
+) -> T:
+    if retries < 1:
+        raise ValueError("retries must be >= 1")
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except Exception as e:
+            if should_retry is not None and not should_retry(e):
+                raise
+            last = e
+            if attempt < retries - 1 and retry_delay:
+                time.sleep(retry_delay)
+    assert last is not None
+    raise last
+
+
+def bytes_to_int(data: bytes, endianness: str = "little") -> int:
+    return int.from_bytes(data, endianness)  # type: ignore[arg-type]
+
+
+def int_to_bytes(value: int, length: int, endianness: str = "little") -> bytes:
+    return value.to_bytes(length, endianness)  # type: ignore[arg-type]
+
+
+def to_hex(data: bytes) -> str:
+    return "0x" + data.hex()
+
+
+def from_hex(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+def xor_bytes(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+def int_div_ceil(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def bit_length(n: int) -> int:
+    return n.bit_length()

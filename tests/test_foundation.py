@@ -1,0 +1,155 @@
+"""Foundation layer tests: params, types, config, logger, utils.
+
+Mirrors the reference's unit coverage for params/config
+(`packages/config/test/unit`, `packages/params/test`) plus the VERDICT
+round-2 gate: construct a minimal-preset genesis BeaconState and
+hash_tree_root it through the typed SSZ layer.
+"""
+
+import pytest
+
+from lodestar_tpu import config as cfg
+from lodestar_tpu import params
+from lodestar_tpu.types import ssz_types
+
+
+class TestParams:
+    def test_presets_differ(self):
+        assert params.MAINNET.SLOTS_PER_EPOCH == 32
+        assert params.MINIMAL.SLOTS_PER_EPOCH == 8
+        assert params.MINIMAL.SYNC_COMMITTEE_SIZE == 32
+
+    def test_set_active_preset(self):
+        prev = params.active_preset()
+        try:
+            params.set_active_preset("minimal")
+            assert params.active_preset().SLOTS_PER_EPOCH == 8
+        finally:
+            params.set_active_preset("mainnet" if prev is params.MAINNET else "minimal")
+
+    def test_domain_constants(self):
+        assert params.DOMAIN_BEACON_PROPOSER == bytes([0, 0, 0, 0])
+        assert params.DOMAIN_SYNC_COMMITTEE == bytes([7, 0, 0, 0])
+
+
+class TestTypes:
+    @pytest.mark.parametrize("fork", ["phase0", "altair", "bellatrix", "capella", "deneb"])
+    def test_default_state_roundtrip_and_root(self, fork):
+        t = ssz_types(params.MINIMAL)
+        state_t = t.forks[fork].BeaconState
+        state = state_t.default()
+        data = state_t.serialize(state)
+        assert state_t.deserialize(data) == state
+        root = state_t.hash_tree_root(state)
+        assert len(root) == 32
+        # deterministic + sensitive to mutation
+        assert root == state_t.hash_tree_root(state)
+        state.slot = 1
+        assert root != state_t.hash_tree_root(state)
+
+    @pytest.mark.parametrize("fork", ["phase0", "altair", "bellatrix", "capella", "deneb"])
+    def test_default_block_roundtrip(self, fork):
+        t = ssz_types(params.MINIMAL)
+        block_t = t.forks[fork].SignedBeaconBlock
+        blk = block_t.default()
+        assert block_t.deserialize(block_t.serialize(blk)) == blk
+
+    def test_genesis_state_with_validators(self):
+        """VERDICT item 4 gate: populated minimal genesis state hashes."""
+        t = ssz_types(params.MINIMAL)
+        state = t.forks["phase0"].BeaconState.default()
+        for i in range(8):
+            v = t.Validator.default()
+            v.pubkey = bytes([i]) * 48
+            v.effective_balance = 32_000_000_000
+            state.validators.append(v)
+            state.balances.append(32_000_000_000)
+        root = t.forks["phase0"].BeaconState.hash_tree_root(state)
+        assert len(root) == 32
+        # validator mutations change the root
+        state.validators[3].slashed = True
+        assert root != t.forks["phase0"].BeaconState.hash_tree_root(state)
+
+    def test_types_cached_per_preset(self):
+        assert ssz_types(params.MINIMAL) is ssz_types(params.MINIMAL)
+        assert ssz_types(params.MINIMAL) is not ssz_types(params.MAINNET)
+
+    def test_attestation_shapes(self):
+        t = ssz_types(params.MAINNET)
+        att = t.Attestation.default()
+        att.aggregation_bits = [True] * 64
+        data = t.Attestation.serialize(att)
+        assert t.Attestation.deserialize(data) == att
+
+
+class TestConfig:
+    def test_fork_schedule_mainnet(self):
+        c = cfg.create_beacon_config(cfg.mainnet_chain_config(), b"\x00" * 32)
+        assert c.fork_name_at_epoch(0) == "phase0"
+        assert c.fork_name_at_epoch(74239) == "phase0"
+        assert c.fork_name_at_epoch(74240) == "altair"
+        assert c.fork_name_at_epoch(144896) == "bellatrix"
+        assert c.fork_name_at_epoch(194048) == "capella"
+
+    def test_fork_digest_distinct_per_fork(self):
+        c = cfg.create_beacon_config(cfg.mainnet_chain_config(), b"\x11" * 32)
+        digests = {c.fork_digest(f) for f in ("phase0", "altair", "bellatrix", "capella")}
+        assert len(digests) == 4
+        assert all(len(d) == 4 for d in digests)
+
+    def test_domain_shape_and_binding(self):
+        c1 = cfg.create_beacon_config(cfg.mainnet_chain_config(), b"\x00" * 32)
+        c2 = cfg.create_beacon_config(cfg.mainnet_chain_config(), b"\x01" * 32)
+        d1 = c1.get_domain(b"\x00\x00\x00\x00", 0)
+        d2 = c2.get_domain(b"\x00\x00\x00\x00", 0)
+        assert len(d1) == 32 and d1[:4] == b"\x00\x00\x00\x00"
+        assert d1 != d2  # bound to genesis_validators_root
+
+    def test_domain_changes_across_fork(self):
+        c = cfg.create_beacon_config(cfg.mainnet_chain_config(), b"\x00" * 32)
+        assert c.get_domain(params.DOMAIN_BEACON_PROPOSER, 0) != c.get_domain(
+            params.DOMAIN_BEACON_PROPOSER, 74240
+        )
+
+    def test_compute_signing_root_matches_container(self):
+        from lodestar_tpu import ssz
+
+        t = ssz_types(params.MINIMAL)
+        cp = t.Checkpoint.default()
+        cp.epoch = 3
+        domain = b"\x07" * 32
+        sd = t.SigningData.default()
+        sd.object_root = t.Checkpoint.hash_tree_root(cp)
+        sd.domain = domain
+        assert cfg.compute_signing_root(t.Checkpoint, cp, domain) == t.SigningData.hash_tree_root(sd)
+
+
+class TestLoggerUtils:
+    def test_logger_child_and_levels(self, capsys):
+        from lodestar_tpu.logger import LoggerOpts, get_logger
+
+        log = get_logger(LoggerOpts(level="info"))
+        net = log.child("network")
+        net.info("peer connected", {"peer": "abc"})
+        err = capsys.readouterr().err
+        assert "peer connected" in err and "network" in err and "peer=abc" in err
+
+    def test_retry_sync(self):
+        from lodestar_tpu.utils import retry_sync
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("boom")
+            return 42
+
+        assert retry_sync(flaky, retries=5) == 42
+        assert len(calls) == 3
+
+    def test_retry_exhaustion_raises(self):
+        from lodestar_tpu.utils import retry_sync
+
+        with pytest.raises(RuntimeError):
+            retry_sync(lambda: (_ for _ in ()).throw(RuntimeError("x")), retries=2)
