@@ -1,0 +1,259 @@
+"""Batched BLS12-381 base-field arithmetic on TPU (JAX).
+
+The device counterpart of the functional CPU oracle
+`lodestar_tpu.crypto.bls.fields` (designed for 1:1 differential testing —
+see that module's docstring). Replaces the blst C field layer the
+reference binds via `@chainsafe/bls`
+(`packages/beacon-node/src/chain/bls/maybeBatch.ts:18`).
+
+Representation (tpu-first):
+
+* An Fp element is 32 little-endian limbs of 12 bits in int32 lanes,
+  shape (..., 32), value canonical (< p) with 12-bit-clean limbs at API
+  boundaries. 12-bit limbs keep every intermediate of a 32x32 schoolbook
+  product + Montgomery reduction strictly inside int32 (max ~2^30), so the
+  whole field stack runs on the VPU with no emulated 64-bit arithmetic.
+* Elements live in Montgomery form (R = 2^384) between `to_mont` /
+  `from_mont`. Multiplication is a polynomial (convolution) product
+  expressed as one batched matmul against a constant one-hot band tensor
+  (XLA maps it to efficient fused multiply-adds), followed by a 32-step
+  Montgomery reduction `fori_loop` — sequential in limbs, fully parallel
+  across the batch, which is where the throughput lives.
+* All public ops are shape-polymorphic over leading batch dims and safe
+  under jit/vmap/shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lodestar_tpu.crypto.bls.fields import P
+
+__all__ = [
+    "LIMBS",
+    "LIMB_BITS",
+    "P",
+    "limbs_from_int",
+    "int_from_limbs",
+    "limbs_from_ints",
+    "ints_from_limbs",
+    "zero",
+    "one_mont",
+    "to_mont",
+    "from_mont",
+    "add",
+    "sub",
+    "neg",
+    "mont_mul",
+    "mont_sq",
+    "pow_const",
+    "inv",
+    "is_zero",
+    "eq",
+]
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+LIMBS = 32  # 32 * 12 = 384 bits >= 381
+
+# --- host-side conversions --------------------------------------------------
+
+
+def limbs_from_int(x: int) -> np.ndarray:
+    """Python int -> (32,) int32 little-endian 12-bit limbs."""
+    if not 0 <= x < (1 << (LIMBS * LIMB_BITS)):
+        raise ValueError("value out of limb range")
+    return np.array([(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(LIMBS)], dtype=np.int32)
+
+
+def int_from_limbs(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64).reshape(-1)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
+
+
+def limbs_from_ints(xs) -> np.ndarray:
+    """List of ints -> (N, 32) int32."""
+    return np.stack([limbs_from_int(x) for x in xs])
+
+
+def ints_from_limbs(arr) -> list[int]:
+    a = np.asarray(arr)
+    return [int_from_limbs(a[i]) for i in range(a.shape[0])]
+
+
+# --- constants --------------------------------------------------------------
+
+P_LIMBS = limbs_from_int(P)
+R_MOD_P = (1 << (LIMBS * LIMB_BITS)) % P  # 2^384 mod p (the Montgomery "1")
+R2_MOD_P = pow(1 << (LIMBS * LIMB_BITS), 2, P)
+# -p^{-1} mod 2^12 (per-limb Montgomery factor)
+PPRIME = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+ONE_MONT_LIMBS = limbs_from_int(R_MOD_P)
+R2_LIMBS = limbs_from_int(R2_MOD_P)
+
+# One-hot band tensor mapping the 32x32 outer product onto the 63 (padded
+# to 64) coefficients of the polynomial product: T[i*32+j, i+j] = 1.
+_T = np.zeros((LIMBS * LIMBS, 2 * LIMBS), dtype=np.int32)
+for _i in range(LIMBS):
+    for _j in range(LIMBS):
+        _T[_i * LIMBS + _j, _i + _j] = 1
+
+
+def zero(batch_shape=()) -> jax.Array:
+    return jnp.zeros((*batch_shape, LIMBS), dtype=jnp.int32)
+
+
+def one_mont(batch_shape=()) -> jax.Array:
+    return jnp.broadcast_to(jnp.asarray(ONE_MONT_LIMBS), (*batch_shape, LIMBS))
+
+
+# --- carry handling ---------------------------------------------------------
+
+
+def _carry_once(x):
+    """One signed carry-propagation pass over the last axis (no wraparound:
+    callers guarantee the true value fits in 384 bits)."""
+    c = x >> LIMB_BITS  # arithmetic shift == floor div, correct for negatives
+    lo = x - (c << LIMB_BITS)
+    return lo + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
+def _carry_full(x, passes: int = 4):
+    """Propagate carries until limbs are 12-bit clean.
+
+    Starting limbs are bounded by ~2^30; each pass shrinks carries by 12
+    bits, so 4 passes reach fixpoint (30 -> 18 -> 6 -> 0 extra bits).
+    """
+    for _ in range(passes):
+        x = _carry_once(x)
+    return x
+
+
+def _cond_sub_p(x):
+    """x - p if x >= p else x; x must be 12-bit clean. Result clean."""
+    d = x - jnp.asarray(P_LIMBS)
+    # borrow-propagate to learn the sign: sequential in limbs but only 32
+    # cheap vector steps; evaluated as one scan at trace time
+    borrow = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    out = []
+    for i in range(LIMBS):
+        t = d[..., i] - borrow
+        borrow = jnp.where(t < 0, 1, 0)
+        out.append(t + (borrow << LIMB_BITS))
+    sub = jnp.stack(out, axis=-1)
+    ge = borrow == 0  # no final borrow => x >= p
+    return jnp.where(ge[..., None], sub, x)
+
+
+# --- public ops -------------------------------------------------------------
+
+
+def add(a, b):
+    """(a + b) mod p; canonical in, canonical out."""
+    return _cond_sub_p(_carry_full(a + b, passes=2))
+
+
+def sub(a, b):
+    """(a - b) mod p; canonical in, canonical out."""
+    return _cond_sub_p(_carry_full(a + jnp.asarray(P_LIMBS) - b, passes=2))
+
+
+def neg(a):
+    """(-a) mod p. neg(0) must stay 0, so subtract conditionally."""
+    nz = jnp.any(a != 0, axis=-1, keepdims=True)
+    return jnp.where(nz, _cond_sub_p(_carry_full(jnp.asarray(P_LIMBS) - a, passes=2)), a)
+
+
+def _mont_reduce(t):
+    """Montgomery reduction of a (.., 64) product accumulator -> (.., 32).
+
+    t limbs are < 2^30 coming in; each of the 32 steps clears one low limb
+    (adding m*p keeps limbs < 2^30 + 2^24*1 per step, bounded < 2^31).
+    """
+    p_limbs = jnp.asarray(P_LIMBS)
+
+    def body(i, t):
+        ci = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
+        m = ((ci & LIMB_MASK) * PPRIME) & LIMB_MASK
+        # t[i : i+32] += m * p
+        window = jax.lax.dynamic_slice_in_dim(t, i, LIMBS, axis=-1)
+        window = window + m[..., None] * p_limbs
+        t = jax.lax.dynamic_update_slice_in_dim(t, window, i, axis=-1)
+        # low limb of t[i] is now 0 mod 2^12; push its carry into t[i+1]
+        ci2 = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
+        carry = ci2 >> LIMB_BITS
+        nxt = jax.lax.dynamic_index_in_dim(t, i + 1, axis=-1, keepdims=False) + carry
+        t = jax.lax.dynamic_update_index_in_dim(t, nxt, i + 1, axis=-1)
+        return t
+
+    t = jax.lax.fori_loop(0, LIMBS, body, t, unroll=4)
+    hi = t[..., LIMBS:]
+    return _cond_sub_p(_carry_full(hi, passes=4))
+
+
+def mont_mul(a, b):
+    """Montgomery product abR^{-1} mod p; canonical in/out.
+
+    The schoolbook product is one batched matmul against the constant band
+    tensor: outer(a,b).reshape(B, 1024) @ T(1024, 64).
+    """
+    outer = a[..., :, None] * b[..., None, :]
+    flat = outer.reshape(*outer.shape[:-2], LIMBS * LIMBS)
+    t = flat @ jnp.asarray(_T)
+    return _mont_reduce(t)
+
+
+def mont_sq(a):
+    return mont_mul(a, a)
+
+
+def to_mont(a):
+    """Standard -> Montgomery form (a * R mod p)."""
+    return mont_mul(a, jnp.asarray(R2_LIMBS))
+
+
+def from_mont(a):
+    """Montgomery -> standard form (a * R^{-1} mod p) via reduction of a."""
+    t = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, LIMBS)])
+    return _mont_reduce(t)
+
+
+def _exp_bits(e: int) -> np.ndarray:
+    """MSB-first bit array of a positive exponent."""
+    return np.array([int(b) for b in bin(e)[2:]], dtype=np.int32)
+
+
+def pow_const(a, e: int):
+    """a^e for a static exponent (square-and-always-multiply over the bit
+    array — branch-free, jit-stable). a in Montgomery form."""
+    if e == 0:
+        return one_mont(a.shape[:-1])
+    bits = jnp.asarray(_exp_bits(e))
+    one = one_mont(a.shape[:-1])
+
+    def body(i, r):
+        r = mont_sq(r)
+        bit = bits[i]
+        mul = jnp.where(bit[..., None] != 0, a, one)
+        return mont_mul(r, mul)
+
+    # first bit is always 1: start from a
+    return jax.lax.fori_loop(1, bits.shape[0], body, a)
+
+
+def inv(a):
+    """a^{-1} via Fermat (a^(p-2)); a in Montgomery form, a != 0."""
+    return pow_const(a, P - 2)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
