@@ -2,27 +2,83 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Current headline: batched SHA-256 merkleization throughput (BASELINE
-config 4 — the `hashTreeRoot(BeaconState)` hot loop, reference
-`packages/state-transition/src/stateTransition.ts:100` via
-`@chainsafe/persistent-merkle-tree` + as-sha256). vs_baseline is the ratio
-against the host hashlib path measured in the same run — the stand-in for
-the reference's WASM as-sha256 single-thread hasher.
+Headline: the NORTH STAR (BASELINE.md config 1) — random-linear-combination
+BLS batch verification throughput on a 128-set batch, the workload the
+reference routes to its blst thread pool
+(`packages/beacon-node/src/chain/bls/multithread/worker.ts:30`,
+`verifyMultipleAggregateSignatures`). The device pipeline is
+`lodestar_tpu.models.batch_verify`: blinded G1/G2 scalar muls, 129 Miller
+loops in lockstep, one shared final exponentiation.
 
-When the BLS device pipeline lands this switches to aggregate sigs/sec
-(north-star metric, BASELINE config 1/2).
+vs_baseline: the reference envelope is ~45 ms for ~100 single-core blst
+signature verifications (`verifyBlocksSignatures.ts:41-43`) ≈ 2,200 sigs/s
+per core. vs_baseline = device_sigs_per_sec / 2200 — i.e. "how many blst
+cores does one TPU chip replace"; ≥10 meets the north-star target.
+
+A secondary line for the SHA-256 merkle kernel is retained in
+`bench_merkle()` (BASELINE config 4) for comparison runs but the driver
+reads only the first printed line.
 """
 
 from __future__ import annotations
 
-import hashlib
 import json
 import time
 
 import numpy as np
 
+REFERENCE_SIGS_PER_SEC_PER_CORE = 2200.0  # blst envelope, see module docstring
+BATCH = 128
+ITERS = 4
 
-def _bench_merkle(depth: int = 20) -> dict:
+
+def _make_sets(n: int):
+    from lodestar_tpu.models.batch_verify import make_synthetic_sets
+
+    return make_synthetic_sets(n, seed=17)
+
+
+def bench_batch_verify() -> dict:
+    from lodestar_tpu.models import batch_verify as bv
+
+    sets = _make_sets(BATCH)
+    inputs = bv.build_device_inputs(sets)
+    assert inputs is not None
+    pk, h, sig, bits, mask = inputs
+
+    # warmup + compile; correctness gate on the first run
+    ok = bool(np.asarray(bv.device_batch_verify(pk, h, sig, bits, mask)))
+    assert ok, "warmup batch failed to verify"
+
+    # steady state: fresh blinding coefficients per job, same compiled
+    # program; dispatch all jobs then drain (the 1-byte result transfer is
+    # the sync point — block_until_ready is unreliable through the axon
+    # relay)
+    jobs = []
+    for i in range(ITERS):
+        coeffs = bv._random_coeffs(BATCH)
+        b = np.zeros_like(bits)
+        b[:BATCH] = bv._bits_msb(coeffs, bv.COEFF_BITS)
+        jobs.append(b)
+    t0 = time.perf_counter()
+    results = [bv.device_batch_verify(pk, h, sig, b, mask) for b in jobs]
+    oks = [bool(np.asarray(r)) for r in results]
+    dt = (time.perf_counter() - t0) / ITERS
+    assert all(oks)
+
+    sigs_per_sec = BATCH / dt
+    return {
+        "metric": "bls_batch_verify_sigs_per_sec",
+        "value": round(sigs_per_sec, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(sigs_per_sec / REFERENCE_SIGS_PER_SEC_PER_CORE, 2),
+    }
+
+
+def bench_merkle(depth: int = 20) -> dict:
+    """Secondary: batched SHA-256 merkleization (BASELINE config 4)."""
+    import hashlib
+
     import jax
 
     from lodestar_tpu.ops import sha256 as S
@@ -31,32 +87,22 @@ def _bench_merkle(depth: int = 20) -> dict:
     rng = np.random.default_rng(0)
     chunks_np = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint32)
     chunks = jax.device_put(chunks_np)
-
-    # warmup/compile all level shapes; synchronize via host transfer of the
-    # 32-byte root — block_until_ready() is a no-op through the axon relay,
-    # so transfers are the only trustworthy sync point
     np.asarray(S.merkle_root_device(chunks))
 
-    # dispatch all iterations first (pipelined, as production batches would
-    # be), then drain: the device executes in order, so total time is
-    # compute-bound with a single 32-byte D2H per tree
     iters = 5
     t0 = time.perf_counter()
     roots = [S.merkle_root_device(chunks) for _ in range(iters)]
     for r in roots:
         np.asarray(r)
     dt = (time.perf_counter() - t0) / iters
-    n_hashes = n - 1  # pair-hashes in a complete binary tree
-    device_rate = n_hashes / dt
+    device_rate = (n - 1) / dt
 
-    # host baseline: hashlib pair-hash rate on a sample, extrapolated
     sample = 1 << 14
     data = chunks_np[: 2 * sample].astype(">u4").tobytes()
     t0 = time.perf_counter()
     for i in range(sample):
         hashlib.sha256(data[i * 64 : (i + 1) * 64]).digest()
-    cpu_dt = time.perf_counter() - t0
-    cpu_rate = sample / cpu_dt
+    cpu_rate = sample / (time.perf_counter() - t0)
 
     return {
         "metric": "merkle_sha256_pair_hashes_per_sec",
@@ -67,8 +113,12 @@ def _bench_merkle(depth: int = 20) -> dict:
 
 
 def main() -> None:
-    result = _bench_merkle()
-    print(json.dumps(result))
+    import os
+
+    from lodestar_tpu.utils import enable_compile_cache
+
+    enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
+    print(json.dumps(bench_batch_verify()))
 
 
 if __name__ == "__main__":

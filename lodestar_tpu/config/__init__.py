@@ -21,6 +21,9 @@ __all__ = [
     "BeaconConfig",
     "mainnet_chain_config",
     "minimal_chain_config",
+    "gnosis_chain_config",
+    "goerli_chain_config",
+    "sepolia_chain_config",
     "create_beacon_config",
     "compute_fork_data_root",
     "compute_domain",
@@ -222,7 +225,79 @@ def minimal_chain_config() -> ChainConfig:
     )
 
 
+def gnosis_chain_config() -> ChainConfig:
+    """Gnosis chain (reference `chainConfig/networks/gnosis.ts` — public
+    chain constants from the eth-clients configs)."""
+    return ChainConfig(
+        PRESET_BASE="gnosis",
+        CONFIG_NAME="gnosis",
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4096,
+        MIN_GENESIS_TIME=1638968400,
+        GENESIS_FORK_VERSION=bytes.fromhex("00000064"),
+        GENESIS_DELAY=6000,
+        ALTAIR_FORK_VERSION=bytes.fromhex("01000064"),
+        ALTAIR_FORK_EPOCH=512,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("02000064"),
+        BELLATRIX_FORK_EPOCH=385536,
+        CAPELLA_FORK_VERSION=bytes.fromhex("03000064"),
+        TERMINAL_TOTAL_DIFFICULTY=8626000000000000000000058750000000000000000000,
+        SECONDS_PER_SLOT=5,
+        SECONDS_PER_ETH1_BLOCK=6,
+        ETH1_FOLLOW_DISTANCE=1024,
+        CHURN_LIMIT_QUOTIENT=4096,
+        DEPOSIT_CHAIN_ID=100,
+        DEPOSIT_NETWORK_ID=100,
+        DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex("0b98057ea310f4d31f2a452b414647007d1645d9"),
+    )
+
+
+def goerli_chain_config() -> ChainConfig:
+    """Goerli/Prater testnet (reference `chainConfig/networks/goerli.ts`)."""
+    return ChainConfig(
+        PRESET_BASE="mainnet",
+        CONFIG_NAME="goerli",
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16384,
+        MIN_GENESIS_TIME=1614588812,
+        GENESIS_FORK_VERSION=bytes.fromhex("00001020"),
+        GENESIS_DELAY=1919188,
+        ALTAIR_FORK_VERSION=bytes.fromhex("01001020"),
+        ALTAIR_FORK_EPOCH=36660,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("02001020"),
+        BELLATRIX_FORK_EPOCH=112260,
+        CAPELLA_FORK_VERSION=bytes.fromhex("03001020"),
+        CAPELLA_FORK_EPOCH=162304,
+        TERMINAL_TOTAL_DIFFICULTY=10790000,
+        DEPOSIT_CHAIN_ID=5,
+        DEPOSIT_NETWORK_ID=5,
+        DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex("ff50ed3d0ec03ac01d4c79aad74928bff48a7b2b"),
+    )
+
+
+def sepolia_chain_config() -> ChainConfig:
+    """Sepolia testnet (reference `chainConfig/networks/sepolia.ts`)."""
+    return ChainConfig(
+        PRESET_BASE="mainnet",
+        CONFIG_NAME="sepolia",
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=1300,
+        MIN_GENESIS_TIME=1655647200,
+        GENESIS_FORK_VERSION=bytes.fromhex("90000069"),
+        ALTAIR_FORK_VERSION=bytes.fromhex("90000070"),
+        ALTAIR_FORK_EPOCH=50,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("90000071"),
+        BELLATRIX_FORK_EPOCH=100,
+        CAPELLA_FORK_VERSION=bytes.fromhex("90000072"),
+        CAPELLA_FORK_EPOCH=56832,
+        TERMINAL_TOTAL_DIFFICULTY=17000000000000000,
+        DEPOSIT_CHAIN_ID=11155111,
+        DEPOSIT_NETWORK_ID=11155111,
+        DEPOSIT_CONTRACT_ADDRESS=bytes.fromhex("7f02c3e3c98b133055b8b348b2ac625669ed295d"),
+    )
+
+
 NETWORKS = {
     "mainnet": mainnet_chain_config,
     "minimal": minimal_chain_config,
+    "gnosis": gnosis_chain_config,
+    "goerli": goerli_chain_config,
+    "sepolia": sepolia_chain_config,
 }

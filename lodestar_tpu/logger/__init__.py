@@ -87,21 +87,32 @@ class LodestarLogger:
 
 
 def get_logger(opts: LoggerOpts | None = None, name: str = "lodestar") -> LodestarLogger:
-    """Reference getNodeLogger equivalent."""
+    """Reference getNodeLogger equivalent.
+
+    Calling again with different opts RECONFIGURES the named logger:
+    existing handlers installed by this function are replaced, so a later
+    call adding `opts.file` (or changing formats/levels) takes full effect
+    instead of being silently dropped.
+    """
     opts = opts or LoggerOpts()
     log = logging.getLogger(name)
     log.setLevel(_level(opts.level))
-    if not log.handlers:
-        h = logging.StreamHandler(sys.stderr)
-        h.setFormatter(logging.Formatter(_FORMAT))
-        h.addFilter(_ModuleTagFilter("node"))
-        log.addHandler(h)
-        if opts.file:
-            fh = logging.FileHandler(opts.file)
-            fh.setFormatter(logging.Formatter(_FORMAT))
-            fh.setLevel(_level(opts.file_level))
-            fh.addFilter(_ModuleTagFilter("node"))
-            log.addHandler(fh)
+    # replace only our own handlers; leave externally-attached ones alone
+    for h in [h for h in log.handlers if getattr(h, "_lodestar_managed", False)]:
+        log.removeHandler(h)
+        h.close()
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(_FORMAT))
+    h.addFilter(_ModuleTagFilter("node"))
+    h._lodestar_managed = True
+    log.addHandler(h)
+    if opts.file:
+        fh = logging.FileHandler(opts.file)
+        fh.setFormatter(logging.Formatter(_FORMAT))
+        fh.setLevel(_level(opts.file_level))
+        fh.addFilter(_ModuleTagFilter("node"))
+        fh._lodestar_managed = True
+        log.addHandler(fh)
     return LodestarLogger(log, opts)
 
 

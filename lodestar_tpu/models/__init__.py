@@ -1,0 +1,18 @@
+"""Device-accelerated verification models — the TPU compute plane.
+
+`batch_verify` replaces the blst worker batch verification the reference
+routes through `BlsMultiThreadWorkerPool`
+(`packages/beacon-node/src/chain/bls/multithread/worker.ts:30`,
+`maybeBatch.ts:18`): same random-linear-combination semantics, one shared
+final exponentiation per batch, but the pairings run as one lockstep
+batched device program instead of N worker threads.
+"""
+
+from .batch_verify import (  # noqa: F401
+    build_device_inputs,
+    device_batch_verify,
+    device_batch_verify_sharded,
+    prepare_sets,
+    verify_signature_sets_device,
+    verify_signature_sets_sharded,
+)

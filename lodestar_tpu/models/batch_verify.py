@@ -1,0 +1,347 @@
+"""Device BLS batch signature verification (random-linear-combination).
+
+Checks  e(-g1, sum_i r_i S_i) * prod_i e(r_i PK_i, H(m_i)) == 1  with ONE
+shared final exponentiation — exactly the semantics of blst's
+`verifyMultipleSignatures` that the reference worker calls
+(`packages/beacon-node/src/chain/bls/multithread/worker.ts:52-96`,
+`maybeBatch.ts:18`), and bit-identical in outcome to the CPU oracle
+`lodestar_tpu.crypto.bls.api.verify_signature_sets`.
+
+Split of labor (SURVEY §7 phase 1):
+
+* **Host**: decompression (sqrt), KeyValidate/subgroup checks, hash-to-G2
+  of the 32-byte signing roots, blinding-coefficient sampling. These are
+  per-set scalar work with data-dependent failure paths — the wrong shape
+  for a lockstep device program — and their cost is amortized by the
+  pubkey/hash caches in the verifier layer above (the reference holds the
+  same split: pubkeys are deserialized once into `EpochContext.index2pubkey`
+  and reused, `state-transition/src/cache/pubkeyCache.ts`).
+* **Device** (one jitted program per padded batch size): 64-bit blinded
+  scalar multiplications in G1 and G2, the G2 fold to the aggregate
+  signature, N+1 Miller loops in lockstep, one product fold, one final
+  exponentiation, the ==1 predicate.
+
+The blinding is mandatory: an unrandomized batch is forgeable (defects in
+different sets can cancel). Coefficient 0 is resampled; the first
+coefficient is 1, as in the oracle.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.crypto.bls.curve import G1_GEN
+from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2
+from lodestar_tpu.crypto.bls.serdes import PointDecodeError, g1_from_bytes, g2_from_bytes
+from lodestar_tpu.ops import curve as cv
+from lodestar_tpu.ops import fp
+from lodestar_tpu.ops import pairing as prg
+from lodestar_tpu.ops import tower as tw
+
+__all__ = [
+    "COEFF_BITS",
+    "prepare_sets",
+    "device_batch_verify",
+    "verify_signature_sets_device",
+]
+
+COEFF_BITS = 64  # blinding scalar width, matches blst's 64-bit rand coeffs
+
+
+def _fp_to_mont_host(xs: list[int]) -> np.ndarray:
+    return np.asarray(fp.to_mont(fp.limbs_from_ints(xs)))
+
+
+def _g1_batch_host(pts) -> tuple[np.ndarray, np.ndarray]:
+    return (
+        _fp_to_mont_host([p[0] for p in pts]),
+        _fp_to_mont_host([p[1] for p in pts]),
+    )
+
+
+def _g2_batch_host(pts) -> tuple[np.ndarray, np.ndarray]:
+    xs = tw.fp2_from_ints([p[0] for p in pts])
+    ys = tw.fp2_from_ints([p[1] for p in pts])
+    return np.asarray(xs), np.asarray(ys)
+
+
+# device-constant: -g1 generator, mont form (computed once at import)
+_NEG_G1_X = _fp_to_mont_host([G1_GEN[0]])[0]
+_NEG_G1_Y = _fp_to_mont_host([(-G1_GEN[1]) % C.P])[0]
+
+
+def _bits_msb(scalars: np.ndarray, width: int) -> np.ndarray:
+    """(N,) uint64-ish ints -> (N, width) int32 bit matrix, MSB first."""
+    out = np.zeros((len(scalars), width), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        s = int(s)
+        for j in range(width):
+            out[i, j] = (s >> (width - 1 - j)) & 1
+    return out
+
+
+def prepare_sets(sets: list[SignatureSet]):
+    """Host precompute: decode + validate + hash. Returns device arrays or
+    None if any set is structurally invalid (decode failure, non-subgroup
+    point, infinity pubkey/signature) — the fail-fast the oracle applies.
+
+    Arrays: pk (x, y), h (x, y), sig (x, y), valid_count.
+    """
+    if not sets:
+        return None
+    pk_pts, h_pts, sig_pts = [], [], []
+    try:
+        for s in sets:
+            pk = g1_from_bytes(s.pubkey)
+            if pk is None or not C.g1_in_subgroup(pk):
+                return None
+            sig = g2_from_bytes(s.signature)
+            if sig is None or not C.g2_in_subgroup(sig):
+                return None
+            pk_pts.append(pk)
+            sig_pts.append(sig)
+            h_pts.append(hash_to_g2(s.message))
+    except PointDecodeError:
+        return None
+    return (
+        _g1_batch_host(pk_pts),
+        _g2_batch_host(h_pts),
+        _g2_batch_host(sig_pts),
+    )
+
+
+@jax.jit
+def _device_batch_verify_impl(pk_x, pk_y, h_x, h_y, sig_x, sig_y, coeff_bits, mask):
+    one1 = fp.one_mont()
+    one2 = tw.fp2_one()
+
+    # blinded scalar multiples (Jacobian): r_i * PK_i in G1, r_i * S_i in G2
+    rpk = cv.scalar_mul_var(cv.F1, (pk_x, pk_y), coeff_bits, one1)
+    rsig = cv.scalar_mul_var(cv.F2, (sig_x, sig_y), coeff_bits, one2)
+
+    # padded entries must not contribute to the signature aggregate:
+    # force their blinded sig to infinity before the fold
+    mcol = mask[:, None, None]
+    rsig = (rsig[0], rsig[1], jnp.where(mcol, rsig[2], jnp.zeros_like(rsig[2])))
+    s_agg = cv.fold_sum(cv.F2, rsig)
+
+    # to affine for the Miller loop (batched Fermat chains)
+    rpk_aff = cv.jac_to_affine_batch(cv.F1, rpk)
+    s_aff = cv.jac_to_affine_batch(cv.F2, tuple(c[None] for c in s_agg))
+    s_inf = cv.jac_is_inf(cv.F2, s_agg)
+
+    # Miller batch: N blinded-pubkey/message pairs + the (-g1, S_agg) pair.
+    # Padded pair entries get the generator pair as a placeholder (any
+    # valid non-infinity point works; the mask drops their Miller value).
+    p_x = jnp.concatenate([rpk_aff[0], _NEG_G1_X[None].astype(jnp.int32)], axis=0)
+    p_y = jnp.concatenate([rpk_aff[1], _NEG_G1_Y[None].astype(jnp.int32)], axis=0)
+    q_x = jnp.concatenate([h_x, s_aff[0]], axis=0)
+    q_y = jnp.concatenate([h_y, s_aff[1]], axis=0)
+    pair_mask = jnp.concatenate([mask, ~s_inf[None]], axis=0)
+
+    # padded / infinite entries: substitute the generator pair so the
+    # Miller loop runs on valid curve points, then mask the result
+    gen_p = (jnp.asarray(_NEG_G1_X), jnp.asarray(_NEG_G1_Y))
+    gen_q_x = jnp.broadcast_to(h_x[0], q_x.shape[1:])
+    gen_q_y = jnp.broadcast_to(h_y[0], q_y.shape[1:])
+    mm = pair_mask[:, None, None]
+    p_x = jnp.where(mm[..., 0], p_x, gen_p[0])
+    p_y = jnp.where(mm[..., 0], p_y, gen_p[1])
+    q_x = jnp.where(mm, q_x, gen_q_x)
+    q_y = jnp.where(mm, q_y, gen_q_y)
+
+    fs = prg.miller_loop((p_x, p_y), (q_x, q_y))
+    f = prg.fp12_product_fold(fs, mask=pair_mask)
+    return tw.fp12_eq_one(prg.final_exponentiation(f))
+
+
+def device_batch_verify(pk, h, sig, coeff_bits, mask) -> jax.Array:
+    """Jitted device verification core.
+
+    pk: (x, y) each (N, 32); h/sig: (x, y) each (N, 2, 32); coeff_bits:
+    (N, 64) int32 MSB-first; mask: (N,) bool — False entries are padding.
+    Returns a scalar bool array.
+    """
+    return _device_batch_verify_impl(
+        pk[0], pk[1], h[0], h[1], sig[0], sig[1],
+        jnp.asarray(coeff_bits), jnp.asarray(mask),
+    )
+
+
+def device_batch_verify_sharded(mesh, pk, h, sig, coeff_bits, mask) -> jax.Array:
+    """Multi-chip batch verification: the signature-set batch is sharded
+    data-parallel over the mesh's 'data' axis (the sharding translation of
+    the reference's worker-pool data parallelism, SURVEY §2c: one 128-set
+    job split across N workers -> one batch split across N chips).
+
+    Per shard: blinded scalar muls, local Miller loops, local Fp12 partial
+    product, local partial G2 fold of the blinded signatures. Cross-chip:
+    one all_gather of the (tiny) partial products and partial signature
+    points rides the ICI; every chip then finishes the fold + the single
+    shared final exponentiation redundantly (SPMD-replicated scalar work).
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = mesh.devices.size
+    one1 = fp.one_mont()
+    one2 = tw.fp2_one()
+
+    def shard_fn(pk_x, pk_y, h_x, h_y, sig_x, sig_y, bits, mask):
+        rpk = cv.scalar_mul_var(cv.F1, (pk_x, pk_y), bits, one1)
+        rsig = cv.scalar_mul_var(cv.F2, (sig_x, sig_y), bits, one2)
+
+        # local partial signature aggregate (masked padding -> infinity)
+        mcol = mask[:, None, None]
+        rsig = (rsig[0], rsig[1], jnp.where(mcol, rsig[2], jnp.zeros_like(rsig[2])))
+        local_sig = cv.fold_sum(cv.F2, rsig)
+
+        # local Miller loops on blinded pubkeys vs message hashes
+        rpk_aff = cv.jac_to_affine_batch(cv.F1, rpk)
+        gen_px = jnp.asarray(_NEG_G1_X)
+        gen_py = jnp.asarray(_NEG_G1_Y)
+        mm = mask[:, None, None]
+        p_x = jnp.where(mm[..., 0], rpk_aff[0], gen_px)
+        p_y = jnp.where(mm[..., 0], rpk_aff[1], gen_py)
+        q_x = jnp.where(mm, h_x, h_x[0])
+        q_y = jnp.where(mm, h_y, h_y[0])
+        fs = prg.miller_loop((p_x, p_y), (q_x, q_y))
+        local_f = prg.fp12_product_fold(fs, mask=mask)
+
+        # cross-chip: gather tiny partials (one fp12 + one G2 point each)
+        all_f = jax.lax.all_gather(local_f, "data")  # (n_dev, 2, 3, 2, 32)
+        all_sig = jax.lax.all_gather(local_sig, "data")  # 3x (n_dev, 2, 32)
+        f = prg.fp12_product_fold(all_f)
+        s_agg = cv.fold_sum(cv.F2, all_sig)
+
+        # final (-g1, S_agg) pair + the one shared final exponentiation
+        s_aff = cv.jac_to_affine_batch(cv.F2, tuple(c[None] for c in s_agg))
+        s_inf = cv.jac_is_inf(cv.F2, s_agg)
+        fin_q_x = jnp.where(s_inf, q_x[0], s_aff[0][0])
+        fin_q_y = jnp.where(s_inf, q_y[0], s_aff[1][0])
+        f_fin = prg.miller_loop(
+            (gen_px[None], gen_py[None]), (fin_q_x[None], fin_q_y[None])
+        )
+        ones = tw.fp12_one((1,))
+        f_fin = jnp.where(s_inf, ones, f_fin)
+        f = tw.fp12_mul(f, f_fin[0])
+        ok = tw.fp12_eq_one(prg.final_exponentiation(f))
+        return ok[None]
+
+    data_spec = P("data")
+    specs = (
+        data_spec, data_spec,  # pk x/y
+        data_spec, data_spec,  # h x/y
+        data_spec, data_spec,  # sig x/y
+        data_spec,  # bits
+        data_spec,  # mask
+    )
+    try:  # jax >= 0.6 renamed the replication-check kwarg
+        fn = shard_map(
+            shard_fn, mesh=mesh, in_specs=specs, out_specs=P("data"), check_vma=False
+        )
+    except TypeError:
+        fn = shard_map(
+            shard_fn, mesh=mesh, in_specs=specs, out_specs=P("data"), check_rep=False
+        )
+    ok = jax.jit(fn)(
+        pk[0], pk[1], h[0], h[1], sig[0], sig[1],
+        jnp.asarray(coeff_bits), jnp.asarray(mask),
+    )
+    return ok.all()
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    size = max(floor, 1 << (n - 1).bit_length())
+    return size
+
+
+def _random_coeffs(n: int) -> np.ndarray:
+    """[1, r_1, ..., r_{n-1}] nonzero 64-bit blinding scalars."""
+    out = np.empty(n, dtype=np.uint64)
+    out[0] = 1
+    for i in range(1, n):
+        k = 0
+        while k == 0:
+            k = int.from_bytes(os.urandom(8), "big")
+        out[i] = k
+    return out
+
+
+def build_device_inputs(sets: list[SignatureSet], size: int | None = None):
+    """Host precompute + padding: decode/validate/hash N sets and pad the
+    arrays to `size` (default: next power of two >= 8, the size-class
+    bucketing that keeps one compiled program per class — the device
+    analogue of the reference's <= 128-sets-per-job chunking,
+    `multithread/index.ts:34-39`). Returns (pk, h, sig, bits, mask) device
+    inputs with fresh blinding coefficients, or None on invalid input.
+    """
+    prepared = prepare_sets(sets)
+    if prepared is None:
+        return None
+    (pk_x, pk_y), (h_x, h_y), (sig_x, sig_y) = prepared
+    n = len(sets)
+    if size is None:
+        size = _pad_pow2(n)
+    if size < n:
+        raise ValueError("pad size smaller than batch")
+
+    def pad1(a):
+        return np.concatenate([a, np.repeat(a[:1], size - n, axis=0)], axis=0) if size != n else a
+
+    coeffs = _random_coeffs(n)
+    bits = np.zeros((size, COEFF_BITS), dtype=np.int32)
+    bits[:n] = _bits_msb(coeffs, COEFF_BITS)
+    mask = np.zeros(size, dtype=bool)
+    mask[:n] = True
+    return (
+        (pad1(pk_x), pad1(pk_y)),
+        (pad1(h_x), pad1(h_y)),
+        (pad1(sig_x), pad1(sig_y)),
+        bits,
+        mask,
+    )
+
+
+def make_synthetic_sets(n: int, seed: int = 1) -> list[SignatureSet]:
+    """Deterministic valid signature sets (bench + driver fixtures)."""
+    from lodestar_tpu.crypto.bls.api import SecretKey, sign
+
+    sets = []
+    for i in range(n):
+        sk = SecretKey((seed * 1000003 + i + 1) * 0xDEADBEEF + 13)
+        msg = bytes([seed & 0xFF, i & 0xFF]) * 16
+        sets.append(SignatureSet(pubkey=sk.to_pubkey(), message=msg, signature=sign(sk, msg)))
+    return sets
+
+
+def verify_signature_sets_device(sets: list[SignatureSet]) -> bool:
+    """End-to-end single-device batch verify of N signature sets."""
+    inputs = build_device_inputs(sets)
+    if inputs is None:
+        return False
+    pk, h, sig, bits, mask = inputs
+    return bool(np.asarray(device_batch_verify(pk, h, sig, bits, mask)))
+
+
+def verify_signature_sets_sharded(sets: list[SignatureSet], mesh) -> bool:
+    """End-to-end data-parallel batch verify over a device mesh."""
+    n_dev = int(mesh.devices.size)
+    n = len(sets)
+    size = max(_pad_pow2(n), n_dev)
+    if size % n_dev:
+        size += n_dev - size % n_dev
+    inputs = build_device_inputs(sets, size=size)
+    if inputs is None:
+        return False
+    pk, h, sig, bits, mask = inputs
+    return bool(np.asarray(device_batch_verify_sharded(mesh, pk, h, sig, bits, mask)))

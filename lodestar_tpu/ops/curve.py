@@ -21,7 +21,7 @@ it (reference batch verify `maybeBatch.ts:16-38`):
 
 from __future__ import annotations
 
-from types import SimpleNamespace
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -34,8 +34,25 @@ __all__ = ["F1", "F2", "jac_double", "jac_add_mixed", "jac_add", "jac_is_inf",
            "jac_to_affine_batch", "scalar_mul_var", "scalar_mul_const",
            "jac_neg", "affine_to_jac", "fold_sum"]
 
-# Field namespaces: mul/sq/add/sub/neg/zero_like/one-ish helpers
-F1 = SimpleNamespace(
+
+class _FieldOps:
+    """Field-op namespace. Identity hash/eq (module singletons) so instances
+    are valid jit static arguments — SimpleNamespace is not (it defines
+    `__eq__`, which drops `__hash__`)."""
+
+    __slots__ = ("mul", "sq", "add", "sub", "neg", "is_zero", "inv")
+
+    def __init__(self, *, mul, sq, add, sub, neg, is_zero, inv):
+        self.mul = mul
+        self.sq = sq
+        self.add = add
+        self.sub = sub
+        self.neg = neg
+        self.is_zero = is_zero
+        self.inv = inv
+
+
+F1 = _FieldOps(
     mul=fp.mont_mul,
     sq=fp.mont_sq,
     add=fp.add,
@@ -44,7 +61,7 @@ F1 = SimpleNamespace(
     is_zero=fp.is_zero,
     inv=fp.inv,
 )
-F2 = SimpleNamespace(
+F2 = _FieldOps(
     mul=tw.fp2_mul,
     sq=tw.fp2_sq,
     add=tw.fp2_add,
@@ -159,13 +176,16 @@ def jac_add(F, p1, p2):
     return out
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
 def scalar_mul_var(F, q_aff, bit_matrix, one):
     """Per-element scalar multiples of affine points.
 
     q_aff: batch of affine points; bit_matrix: (B, nbits) int32, MSB first
     (host-prepared from the runtime scalars). Branch-free: the add is
-    always computed and selected per element.
+    always computed and selected per element. Jitted with the field
+    namespace static (F1/F2 are module singletons).
     """
+    bit_matrix = jnp.asarray(bit_matrix)  # accept host numpy input under jit
     nbits = bit_matrix.shape[-1]
     x = q_aff[0]
     zero_pt = (jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros_like(x))
@@ -180,6 +200,7 @@ def scalar_mul_var(F, q_aff, bit_matrix, one):
     return acc
 
 
+@functools.partial(jax.jit, static_argnums=(0, 2))
 def scalar_mul_const(F, q_aff, scalar: int, one):
     """Static-scalar multiples (subgroup check by r, h_eff clearing).
 
@@ -209,6 +230,7 @@ def scalar_mul_const(F, q_aff, scalar: int, one):
     return acc
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
 def fold_sum(F, pts):
     """Sum a batch of Jacobian points down the batch axis (tree fold).
 
@@ -230,6 +252,7 @@ def fold_sum(F, pts):
     return tuple(c[0] for c in pt)
 
 
+@functools.partial(jax.jit, static_argnums=(0,))
 def jac_to_affine_batch(F, pt):
     """Jacobian -> affine for a batch (per-element field inversion, fully
     vectorized: the Fermat chain runs once across the whole batch).

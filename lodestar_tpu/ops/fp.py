@@ -123,31 +123,54 @@ def _carry_once(x):
     return lo + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
 
 
-def _carry_full(x, passes: int = 4):
-    """Propagate carries until limbs are 12-bit clean.
+def _carry_seq(x):
+    """Exact carry normalization: one sequential 32-step pass with full
+    (multi-bit, possibly negative) carry-in per limb. Unlike repeated
+    `_carry_once` passes — which move a carry *ripple* only one limb per
+    pass and can leave a limb at exactly 2^12 (e.g. limb sums
+    [4096, 4095, 4095, ...]) — this always produces 12-bit-clean limbs,
+    which `_cond_sub_p` / `eq` rely on. The final carry out of limb 31 is
+    dropped: callers guarantee the true value is in [0, 2^384).
 
-    Starting limbs are bounded by ~2^30; each pass shrinks carries by 12
-    bits, so 4 passes reach fixpoint (30 -> 18 -> 6 -> 0 extra bits).
+    Expressed as a lax.scan over the limb axis so each call site costs a
+    handful of graph nodes — the pairing traces thousands of these.
     """
-    for _ in range(passes):
+    xs = jnp.moveaxis(x, -1, 0)  # (32, ...)
+    carry = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+
+    def step(carry, xi):
+        t = xi + carry
+        return t >> LIMB_BITS, t & LIMB_MASK  # arithmetic shift: floor
+
+    _, out = jax.lax.scan(step, carry, xs)
+    return jnp.moveaxis(out, 0, -1)
+
+
+def _carry_full(x, passes: int = 4):
+    """Shrink limb magnitudes with `passes` parallel passes (each pass
+    divides the carry size by 2^12), then run one exact sequential pass so
+    the result is guaranteed 12-bit clean regardless of carry ripples."""
+    for _ in range(passes - 1):
         x = _carry_once(x)
-    return x
+    return _carry_seq(x)
 
 
 def _cond_sub_p(x):
-    """x - p if x >= p else x; x must be 12-bit clean. Result clean."""
-    d = x - jnp.asarray(P_LIMBS)
-    # borrow-propagate to learn the sign: sequential in limbs but only 32
-    # cheap vector steps; evaluated as one scan at trace time
-    borrow = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
-    out = []
-    for i in range(LIMBS):
-        t = d[..., i] - borrow
+    """x - p if x >= p else x; x must be 12-bit clean. Result clean.
+
+    Borrow propagation as a lax.scan over the limb axis (compact graph —
+    see _carry_seq)."""
+    d = jnp.moveaxis(x - jnp.asarray(P_LIMBS), -1, 0)  # (32, ...)
+    borrow0 = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+
+    def step(borrow, di):
+        t = di - borrow
         borrow = jnp.where(t < 0, 1, 0)
-        out.append(t + (borrow << LIMB_BITS))
-    sub = jnp.stack(out, axis=-1)
+        return borrow, t + (borrow << LIMB_BITS)
+
+    borrow, sub = jax.lax.scan(step, borrow0, d)
     ge = borrow == 0  # no final borrow => x >= p
-    return jnp.where(ge[..., None], sub, x)
+    return jnp.where(ge[..., None], jnp.moveaxis(sub, 0, -1), x)
 
 
 # --- public ops -------------------------------------------------------------

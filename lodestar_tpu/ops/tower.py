@@ -101,11 +101,19 @@ def fp2_conj(a):
 
 
 def fp2_mul(a, b):
+    """Karatsuba Fp2 product as ONE stacked mont_mul dispatch.
+
+    The three base-field products (t0, t1, cross) are independent, so they
+    are stacked along a fresh axis and computed by a single batched
+    `fp.mont_mul` — 3x fewer (and 3x larger) device ops per call, which is
+    both the TPU dispatch win and what keeps traced pairing graphs small.
+    """
     a0, a1 = a[..., 0, :], a[..., 1, :]
     b0, b1 = b[..., 0, :], b[..., 1, :]
-    t0 = fp.mont_mul(a0, b0)
-    t1 = fp.mont_mul(a1, b1)
-    cross = fp.mont_mul(fp.add(a0, a1), fp.add(b0, b1))
+    lhs = jnp.stack([a0, a1, fp.add(a0, a1)], axis=-2)
+    rhs = jnp.stack([b0, b1, fp.add(b0, b1)], axis=-2)
+    m = fp.mont_mul(lhs, rhs)
+    t0, t1, cross = m[..., 0, :], m[..., 1, :], m[..., 2, :]
     c0 = fp.sub(t0, t1)
     c1 = fp.sub(fp.sub(cross, t0), t1)
     return jnp.stack([c0, c1], axis=-2)
@@ -113,11 +121,12 @@ def fp2_mul(a, b):
 
 def fp2_sq(a):
     a0, a1 = a[..., 0, :], a[..., 1, :]
-    # (a0+a1)(a0-a1) + 2 a0 a1 u
-    c0 = fp.mont_mul(fp.add(a0, a1), fp.sub(a0, a1))
-    c1 = fp.mont_mul(a0, a1)
-    c1 = fp.add(c1, c1)
-    return jnp.stack([c0, c1], axis=-2)
+    # (a0+a1)(a0-a1) + 2 a0 a1 u — both products in one dispatch
+    lhs = jnp.stack([fp.add(a0, a1), a0], axis=-2)
+    rhs = jnp.stack([fp.sub(a0, a1), a1], axis=-2)
+    m = fp.mont_mul(lhs, rhs)
+    c0, c1 = m[..., 0, :], m[..., 1, :]
+    return jnp.stack([c0, fp.add(c1, c1)], axis=-2)
 
 
 def fp2_mul_small(a, k: int):
@@ -137,17 +146,18 @@ def fp2_mul_xi(a):
 
 
 def fp2_mul_fp(a, s):
-    """Multiply Fp2 element by an Fp scalar (mont form), shape (.., 32)."""
-    return jnp.stack(
-        [fp.mont_mul(a[..., 0, :], s), fp.mont_mul(a[..., 1, :], s)], axis=-2
-    )
+    """Multiply Fp2 element by an Fp scalar (mont form), shape (.., 32).
+
+    One broadcast mont_mul over the coefficient axis."""
+    return fp.mont_mul(a, s[..., None, :])
 
 
 def fp2_inv(a):
-    a0, a1 = a[..., 0, :], a[..., 1, :]
-    norm = fp.add(fp.mont_mul(a0, a0), fp.mont_mul(a1, a1))
+    sq = fp.mont_mul(a, a)  # a0^2, a1^2 in one dispatch
+    norm = fp.add(sq[..., 0, :], sq[..., 1, :])
     ninv = fp.inv(norm)
-    return jnp.stack([fp.mont_mul(a0, ninv), fp.neg(fp.mont_mul(a1, ninv))], axis=-2)
+    scaled = fp.mont_mul(a, ninv[..., None, :])
+    return jnp.stack([scaled[..., 0, :], fp.neg(scaled[..., 1, :])], axis=-2)
 
 
 def fp2_is_zero(a):
@@ -170,21 +180,37 @@ def fp6_neg(a):
 
 
 def fp6_mul(a, b):
+    """Toom/Karatsuba Fp6 product: all 6 Fp2 products in ONE stacked
+    fp2_mul call (= one mont_mul dispatch of 18x the batch)."""
     a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
     b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
-    t0 = fp2_mul(a0, b0)
-    t1 = fp2_mul(a1, b1)
-    t2 = fp2_mul(a2, b2)
-    c0 = fp2_add(
-        t0,
-        fp2_mul_xi(fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)),
+    # pair sums (a1+a2, a0+a1, a0+a2) in one fp.add
+    sa = fp.add(
+        jnp.stack([a1, a0, a0], axis=-3), jnp.stack([a2, a1, a2], axis=-3)
     )
-    c1 = fp2_add(
-        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
-        fp2_mul_xi(t2),
+    sb = fp.add(
+        jnp.stack([b1, b0, b0], axis=-3), jnp.stack([b2, b1, b2], axis=-3)
     )
-    c2 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1)
-    return jnp.stack([c0, c1, c2], axis=-3)
+    lhs = jnp.concatenate([jnp.stack([a0, a1, a2], axis=-3), sa], axis=-3)
+    rhs = jnp.concatenate([jnp.stack([b0, b1, b2], axis=-3), sb], axis=-3)
+    m = fp2_mul(lhs, rhs)  # t0, t1, t2, m12, m01, m02
+    t0, t1, t2 = m[..., 0, :, :], m[..., 1, :, :], m[..., 2, :, :]
+    m12, m01, m02 = m[..., 3, :, :], m[..., 4, :, :], m[..., 5, :, :]
+    # u_xy = m_xy - t_x - t_y, all three in one stacked sub pair
+    u = fp.sub(
+        fp.sub(
+            jnp.stack([m12, m01, m02], axis=-3),
+            jnp.stack([t1, t0, t0], axis=-3),
+        ),
+        jnp.stack([t2, t1, t2], axis=-3),
+    )
+    u12, u01, u02 = u[..., 0, :, :], u[..., 1, :, :], u[..., 2, :, :]
+    xi = fp2_mul_xi(jnp.stack([u12, t2], axis=-3))
+    c = fp.add(
+        jnp.stack([t0, u01, u02], axis=-3),
+        jnp.stack([xi[..., 0, :, :], xi[..., 1, :, :], t1], axis=-3),
+    )
+    return c
 
 
 def fp6_sq(a):
@@ -199,13 +225,27 @@ def fp6_mul_by_v(a):
 
 def fp6_inv(a):
     a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
-    c0 = fp2_sub(fp2_sq(a0), fp2_mul_xi(fp2_mul(a1, a2)))
-    c1 = fp2_sub(fp2_mul_xi(fp2_sq(a2)), fp2_mul(a0, a1))
-    c2 = fp2_sub(fp2_sq(a1), fp2_mul(a0, a2))
-    t = fp2_add(fp2_mul(a0, c0), fp2_mul_xi(fp2_add(fp2_mul(a2, c1), fp2_mul(a1, c2))))
+    # six products (a0^2, a1*a2, xi path ...) in one stacked fp2_mul
+    m = fp2_mul(
+        jnp.stack([a0, a1, a2, a0, a1, a0], axis=-3),
+        jnp.stack([a0, a2, a2, a1, a1, a2], axis=-3),
+    )
+    sq0, m12, sq2, m01, sq1, m02 = (m[..., i, :, :] for i in range(6))
+    xi = fp2_mul_xi(jnp.stack([m12, sq2], axis=-3))
+    c0 = fp2_sub(sq0, xi[..., 0, :, :])
+    c1 = fp2_sub(xi[..., 1, :, :], m01)
+    c2 = fp2_sub(sq1, m02)
+    # t = a0 c0 + xi (a2 c1 + a1 c2): three products in one dispatch
+    tm = fp2_mul(
+        jnp.stack([a0, a2, a1], axis=-3), jnp.stack([c0, c1, c2], axis=-3)
+    )
+    t = fp2_add(
+        tm[..., 0, :, :],
+        fp2_mul_xi(fp2_add(tm[..., 1, :, :], tm[..., 2, :, :])),
+    )
     tinv = fp2_inv(t)
-    return jnp.stack(
-        [fp2_mul(c0, tinv), fp2_mul(c1, tinv), fp2_mul(c2, tinv)], axis=-3
+    return fp2_mul(
+        jnp.stack([c0, c1, c2], axis=-3), tinv[..., None, :, :]
     )
 
 
@@ -218,12 +258,16 @@ def fp12_one(batch_shape=()):
 
 
 def fp12_mul(a, b):
+    """Karatsuba Fp12 product: all 54 base-field products ride ONE
+    mont_mul dispatch (3 stacked fp6_mul -> 18 stacked fp2_mul -> 54)."""
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
-    t0 = fp6_mul(a0, b0)
-    t1 = fp6_mul(a1, b1)
+    lhs = jnp.stack([a0, a1, fp6_add(a0, a1)], axis=-4)
+    rhs = jnp.stack([b0, b1, fp6_add(b0, b1)], axis=-4)
+    m = fp6_mul(lhs, rhs)
+    t0, t1, cross = m[..., 0, :, :, :], m[..., 1, :, :, :], m[..., 2, :, :, :]
     c0 = fp6_add(t0, fp6_mul_by_v(t1))
-    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    c1 = fp6_sub(fp6_sub(cross, t0), t1)
     return jnp.stack([c0, c1], axis=-4)
 
 
@@ -237,9 +281,14 @@ def fp12_conj(a):
 
 def fp12_inv(a):
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
-    t = fp6_sub(fp6_sq(a0), fp6_mul_by_v(fp6_sq(a1)))
+    both = jnp.stack([a0, a1], axis=-4)
+    sq = fp6_mul(both, both)  # a0^2, a1^2 in one dispatch
+    t = fp6_sub(sq[..., 0, :, :, :], fp6_mul_by_v(sq[..., 1, :, :, :]))
     tinv = fp6_inv(t)
-    return jnp.stack([fp6_mul(a0, tinv), fp6_neg(fp6_mul(a1, tinv))], axis=-4)
+    scaled = fp6_mul(both, tinv[..., None, :, :, :])
+    return jnp.stack(
+        [scaled[..., 0, :, :, :], fp6_neg(scaled[..., 1, :, :, :])], axis=-4
+    )
 
 
 def fp12_eq_one(a):
@@ -281,16 +330,15 @@ def _from_w_coeffs(c):
 
 
 def fp12_frobenius(a, power: int = 1):
-    """a^(p^power) for power in {1, 2, 3}, coefficient-wise."""
+    """a^(p^power) for power in {1, 2, 3}, coefficient-wise (all six
+    coefficient products in one stacked fp2_mul)."""
     if power not in (1, 2, 3):
         raise ValueError("frobenius power must be 1..3")
-    coeffs = _to_w_coeffs(a)
-    out = []
-    gk = jnp.asarray(_FROB_K[power])
-    for i, c in enumerate(coeffs):
-        ci = fp2_conj(c) if power % 2 == 1 else c
-        out.append(fp2_mul(ci, gk[i]))
-    return _from_w_coeffs(out)
+    stacked = jnp.stack(_to_w_coeffs(a), axis=-3)  # (.., 6, 2, 32)
+    if power % 2 == 1:
+        stacked = fp2_conj(stacked)
+    prod = fp2_mul(stacked, jnp.asarray(_FROB_K[power]))
+    return _from_w_coeffs([prod[..., i, :, :] for i in range(6)])
 
 
 # --- oracle bridge ----------------------------------------------------------

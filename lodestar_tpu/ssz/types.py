@@ -237,14 +237,23 @@ class List(SSZType):
         if len(value) > self.limit:
             raise ValueError(f"List[{self.limit}]: got {len(value)} elements")
         if _is_basic(self.elem):
+            from .batch import pack_basic_chunks  # local import avoids cycle
+
             elem_size = self.elem.fixed_size()
             limit_chunks = (self.limit * elem_size + _BYTES_PER_CHUNK - 1) // _BYTES_PER_CHUNK
-            root = merkleize(
-                pack_bytes(b"".join(self.elem.serialize(v) for v in value)),
-                limit=max(limit_chunks, 1),
-            )
+            root = merkleize(pack_basic_chunks(self.elem, value), limit=max(limit_chunks, 1))
         else:
-            roots = b"".join(self.elem.hash_tree_root(v) for v in value)
+            roots = None
+            if isinstance(self.elem, Container) and len(value) >= 64:
+                # vectorized batch rooter (device-batched hash levels) for
+                # big homogeneous lists — the validators hot path
+                from .batch import batch_container_roots
+
+                roots_arr = batch_container_roots(self.elem, value)
+                if roots_arr is not None:
+                    roots = roots_arr.tobytes()
+            if roots is None:
+                roots = b"".join(self.elem.hash_tree_root(v) for v in value)
             root = merkleize(roots, limit=max(self.limit, 1))
         return mix_in_length(root, len(value))
 
@@ -421,12 +430,21 @@ class ContainerValue:
         return self._type
 
     def copy(self) -> "ContainerValue":
-        """Shallow-ish copy: nested lists copied one level (spec-test mutation safety)."""
-        vals = {}
-        for fname, _ in self._type.fields:
-            v = getattr(self, fname)
-            vals[fname] = list(v) if isinstance(v, list) else v
-        return ContainerValue(self._type, **vals)
+        """Recursive copy: nested containers and lists are copied all the
+        way down, so mutating a copy can never alias the original (the
+        state-transition clones pre-states before applying blocks —
+        reference ssz ViewDU .clone() semantics)."""
+
+        def cp(v):
+            if isinstance(v, ContainerValue):
+                return v.copy()
+            if isinstance(v, list):
+                return [cp(x) for x in v]
+            return v
+
+        return ContainerValue(
+            self._type, **{n: cp(getattr(self, n)) for n in self._type._field_names}
+        )
 
     def __eq__(self, other):
         return (

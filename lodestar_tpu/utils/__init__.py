@@ -27,7 +27,22 @@ __all__ = [
     "bit_length",
     "ErrorAborted",
     "TimeoutError_",
+    "enable_compile_cache",
 ]
+
+
+def enable_compile_cache(repo_root: str | None = None) -> None:
+    """Persistent XLA compile cache under `<repo>/.jax_cache` — the
+    pairing/batch-verify graphs compile once per machine instead of once
+    per process. Shared by bench.py, __graft_entry__.py and tests."""
+    import os
+
+    import jax
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    jax.config.update("jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
 
 class ErrorAborted(Exception):

@@ -20,3 +20,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the pairing / batch-verify graphs take
+# minutes to compile on the CPU backend; caching makes repeat test runs
+# (and the driver's round-end run) pay compile once per machine.
+from lodestar_tpu.utils import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(os.path.join(os.path.dirname(__file__), ".."))

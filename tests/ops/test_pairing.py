@@ -1,0 +1,104 @@
+"""Differential tests: device batched pairing vs the CPU oracle.
+
+The device Miller loop scales lines by Fp2 denominators, so raw Miller
+outputs differ from the oracle by a subfield factor — equality holds
+*after* final exponentiation. The final exponentiation itself is an exact
+op-for-op mirror, so it is pinned directly on arbitrary Fp12 inputs.
+
+All tests run at batch size 4: XLA compiles of the pairing graph dominate
+test wall-clock on the CPU mesh, and a single canonical shape means each
+program (miller@4, finalexp@4, finalexp@1, multi@4) compiles exactly once
+for the whole module.
+"""
+
+import numpy as np
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.crypto.bls import pairing as orc
+from lodestar_tpu.crypto.bls.curve import G1_GEN, G2_GEN
+from lodestar_tpu.crypto.bls.fields import R
+from lodestar_tpu.ops import pairing as prg, tower as tw
+
+from .util import g1_to_dev, g2_to_dev, rand_fp12
+
+A, B = 31337, 271828
+PAIRS4 = [
+    (G1_GEN, G2_GEN),
+    (C.g1_mul(G1_GEN, 5), C.g2_mul(G2_GEN, 7)),
+    (C.g1_mul(G1_GEN, 123456789), C.g2_mul(G2_GEN, 987654321)),
+    (C.g1_mul(G1_GEN, A), C.g2_mul(G2_GEN, B)),  # bilinearity probe
+]
+
+
+def dev4(pairs):
+    assert len(pairs) == 4
+    ps = g1_to_dev([p for p, _ in pairs])
+    qs = g2_to_dev([q for _, q in pairs])
+    return ps, qs
+
+
+class TestFinalExponentiation:
+    def test_matches_oracle_on_random_fp12(self):
+        xs = rand_fp12(4, seed=60)
+        got = tw.fp12_to_oracle(
+            np.asarray(prg.final_exponentiation(tw.fp12_from_oracle(xs)))
+        )
+        assert got == [orc.final_exponentiation(a) for a in xs]
+
+
+class TestPairing:
+    def test_batch_matches_oracle_and_bilinearity(self):
+        ps, qs = dev4(PAIRS4)
+        got = tw.fp12_to_oracle(np.asarray(prg.pairing(ps, qs)))
+        # element-wise parity with the oracle pairing
+        assert got == [orc.pairing(p, q) for p, q in PAIRS4]
+        # bilinearity through the device value: e(aP, bQ) == e(abP, Q)
+        assert F.fp12_eq(got[3], orc.pairing(C.g1_mul(G1_GEN, A * B % R), G2_GEN))
+
+
+class TestMultiPairing:
+    def test_product_relations_with_mask(self):
+        s = 0xC0FFEE
+        # slots: [-g1*G, sQ], [sG, Q], two masked garbage slots
+        ps, qs = dev4(
+            [
+                (C.g1_neg(G1_GEN), C.g2_mul(G2_GEN, s)),
+                (C.g1_mul(G1_GEN, s), G2_GEN),
+                (C.g1_mul(G1_GEN, 777), C.g2_mul(G2_GEN, 3)),
+                (C.g1_mul(G1_GEN, 778), C.g2_mul(G2_GEN, 4)),
+            ]
+        )
+        mask_valid = np.array([True, True, False, False])
+        assert bool(np.asarray(prg.multi_pairing_is_one(ps, qs, mask=mask_valid)))
+
+        # unmasking garbage must break the product
+        mask_all = np.array([True, True, True, False])
+        assert not bool(np.asarray(prg.multi_pairing_is_one(ps, qs, mask=mask_all)))
+
+        # wrong scalar relation must reject (same compiled program)
+        ps_bad, qs_bad = dev4(
+            [
+                (C.g1_neg(G1_GEN), C.g2_mul(G2_GEN, s)),
+                (C.g1_mul(G1_GEN, s + 1), G2_GEN),
+                (C.g1_mul(G1_GEN, 777), C.g2_mul(G2_GEN, 3)),
+                (C.g1_mul(G1_GEN, 778), C.g2_mul(G2_GEN, 4)),
+            ]
+        )
+        assert not bool(
+            np.asarray(prg.multi_pairing_is_one(ps_bad, qs_bad, mask=mask_valid))
+        )
+
+    def test_multi_matches_oracle_multi(self):
+        pairs = [
+            (C.g1_mul(G1_GEN, 11), C.g2_mul(G2_GEN, 13)),
+            (C.g1_mul(G1_GEN, 17), C.g2_mul(G2_GEN, 19)),
+            (C.g1_mul(G1_GEN, 23), C.g2_mul(G2_GEN, 29)),
+            (C.g1_mul(G1_GEN, 31), C.g2_mul(G2_GEN, 37)),
+        ]
+        ps, qs = dev4(pairs)
+        fs = prg.miller_loop(ps, qs)  # reuses the miller@4 compile
+        got = tw.fp12_to_oracle(
+            np.asarray(prg.final_exponentiation(prg.fp12_product_fold(fs)[None]))
+        )[0]
+        assert F.fp12_eq(got, orc.multi_pairing(pairs))

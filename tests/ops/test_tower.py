@@ -1,0 +1,123 @@
+"""Differential tests: device Fp2/Fp6/Fp12 tower vs the CPU oracle."""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.ops import fp, tower as tw
+
+from .util import (
+    assert_clean,
+    fp2_from_dev,
+    fp2_to_dev,
+    fp6_from_dev,
+    fp6_to_dev,
+    fp_to_dev,
+    rand_fp2,
+    rand_fp6,
+    rand_fp12,
+)
+
+P = F.P
+
+FP2_EDGE = [(0, 0), (1, 0), (0, 1), (P - 1, P - 1), (P - 1, 0), (0, P - 1), (1, 1)]
+
+
+class TestFp2:
+    @pytest.mark.parametrize("op,oracle", [
+        (tw.fp2_add, F.fp2_add),
+        (tw.fp2_sub, F.fp2_sub),
+        (tw.fp2_mul, F.fp2_mul),
+    ])
+    def test_binary(self, op, oracle):
+        xs = FP2_EDGE + rand_fp2(6, seed=20)
+        ys = list(reversed(FP2_EDGE)) + rand_fp2(6, seed=21)
+        got = np.asarray(op(fp2_to_dev(xs), fp2_to_dev(ys)))
+        assert_clean(got)
+        assert fp2_from_dev(got) == [oracle(a, b) for a, b in zip(xs, ys)]
+
+    @pytest.mark.parametrize("op,oracle", [
+        (tw.fp2_neg, F.fp2_neg),
+        (tw.fp2_conj, F.fp2_conj),
+        (tw.fp2_sq, F.fp2_sq),
+        (tw.fp2_mul_xi, F.fp2_mul_xi),
+    ])
+    def test_unary(self, op, oracle):
+        xs = FP2_EDGE + rand_fp2(6, seed=22)
+        got = np.asarray(op(fp2_to_dev(xs)))
+        assert_clean(got)
+        assert fp2_from_dev(got) == [oracle(a) for a in xs]
+
+    def test_inv(self):
+        xs = [(1, 0), (0, 1), (P - 1, P - 1)] + rand_fp2(3, seed=23)
+        got = fp2_from_dev(np.asarray(tw.fp2_inv(fp2_to_dev(xs))))
+        assert got == [F.fp2_inv(a) for a in xs]
+
+    def test_mul_small_and_mul_fp(self):
+        xs = rand_fp2(4, seed=24)
+        for k in (0, 1, 2, 3):
+            got = fp2_from_dev(np.asarray(tw.fp2_mul_small(fp2_to_dev(xs), k)))
+            assert got == [F.fp2_mul_scalar(a, k) for a in xs]
+        s = 0xDEADBEEF
+        got = fp2_from_dev(
+            np.asarray(tw.fp2_mul_fp(fp2_to_dev(xs), fp_to_dev([s] * len(xs))))
+        )
+        assert got == [F.fp2_mul_scalar(a, s) for a in xs]
+
+    def test_is_zero(self):
+        xs = [(0, 0), (1, 0), (0, 1)]
+        assert list(np.asarray(tw.fp2_is_zero(fp2_to_dev(xs)))) == [True, False, False]
+
+
+class TestFp6:
+    def test_mul(self):
+        xs = rand_fp6(5, seed=30)
+        ys = rand_fp6(5, seed=31)
+        got = np.asarray(tw.fp6_mul(fp6_to_dev(xs), fp6_to_dev(ys)))
+        assert_clean(got)
+        assert fp6_from_dev(got) == [F.fp6_mul(a, b) for a, b in zip(xs, ys)]
+
+    def test_mul_by_v(self):
+        xs = rand_fp6(4, seed=32)
+        got = fp6_from_dev(np.asarray(tw.fp6_mul_by_v(fp6_to_dev(xs))))
+        assert got == [F.fp6_mul_by_v(a) for a in xs]
+
+    def test_inv(self):
+        xs = rand_fp6(3, seed=33)
+        got = fp6_from_dev(np.asarray(tw.fp6_inv(fp6_to_dev(xs))))
+        assert got == [F.fp6_inv(a) for a in xs]
+
+
+def fp12_dev(vals):
+    return tw.fp12_from_oracle(vals)
+
+
+class TestFp12:
+    def test_mul(self):
+        xs = rand_fp12(3, seed=40)
+        ys = rand_fp12(3, seed=41)
+        got = np.asarray(tw.fp12_mul(fp12_dev(xs), fp12_dev(ys)))
+        assert_clean(got)
+        assert tw.fp12_to_oracle(got) == [F.fp12_mul(a, b) for a, b in zip(xs, ys)]
+
+    def test_sq_conj_inv(self):
+        xs = rand_fp12(3, seed=42)
+        dev = fp12_dev(xs)
+        assert tw.fp12_to_oracle(np.asarray(tw.fp12_sq(dev))) == [F.fp12_sq(a) for a in xs]
+        assert tw.fp12_to_oracle(np.asarray(tw.fp12_conj(dev))) == [F.fp12_conj(a) for a in xs]
+        assert tw.fp12_to_oracle(np.asarray(tw.fp12_inv(dev))) == [F.fp12_inv(a) for a in xs]
+
+    @pytest.mark.parametrize("power", [1, 2, 3])
+    def test_frobenius(self, power):
+        xs = rand_fp12(2, seed=43 + power)
+        got = tw.fp12_to_oracle(np.asarray(tw.fp12_frobenius(fp12_dev(xs), power)))
+        assert got == [F.fp12_frobenius(a, power) for a in xs]
+
+    def test_eq_one(self):
+        xs = [F.FP12_ONE] + rand_fp12(2, seed=50)
+        got = list(np.asarray(tw.fp12_eq_one(fp12_dev(xs))))
+        assert got == [True, False, False]
+
+    def test_oracle_bridge_roundtrip(self):
+        xs = rand_fp12(3, seed=51)
+        assert tw.fp12_to_oracle(fp12_dev(xs)) == xs

@@ -1,0 +1,113 @@
+"""Shared helpers for differential-testing the device ops against the CPU
+oracle (`lodestar_tpu.crypto.bls`). Host-side conversions only."""
+
+import numpy as np
+
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.ops import fp, tower as tw
+
+P = F.P
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def rand_fp_ints(n, seed=0):
+    r = rng(seed)
+    # uniform in [0, p) via rejection on 384-bit draws
+    out = []
+    while len(out) < n:
+        v = int.from_bytes(r.bytes(48), "little")
+        if v < P:
+            out.append(v)
+    return out
+
+
+def fp_to_dev(xs):
+    """List of ints -> (N, 32) mont-form device limbs."""
+    return np.asarray(fp.to_mont(fp.limbs_from_ints(xs)))
+
+
+def fp_from_dev(arr):
+    """Mont-form device limbs -> list of ints."""
+    return fp.ints_from_limbs(np.asarray(fp.from_mont(arr)))
+
+
+def assert_clean(arr):
+    """All limbs 12-bit clean (the canonical-representation contract)."""
+    a = np.asarray(arr)
+    assert a.min() >= 0 and a.max() <= fp.LIMB_MASK, (
+        f"limbs not 12-bit clean: min={a.min()} max={a.max()}"
+    )
+
+
+def rand_fp2(n, seed=0):
+    xs = rand_fp_ints(2 * n, seed)
+    return [(xs[2 * i], xs[2 * i + 1]) for i in range(n)]
+
+
+def fp2_to_dev(vals):
+    return tw.fp2_from_ints(vals)
+
+
+def fp2_from_dev(arr):
+    return tw.fp2_to_ints(arr)
+
+
+def rand_fp6(n, seed=0):
+    cs = rand_fp2(3 * n, seed)
+    return [tuple(cs[3 * i : 3 * i + 3]) for i in range(n)]
+
+
+def fp6_to_dev(vals):
+    flat = [c for v in vals for c in v]
+    return fp2_to_dev(flat).reshape(len(vals), 3, 2, fp.LIMBS)
+
+
+def fp6_from_dev(arr):
+    flat = fp2_from_dev(np.asarray(arr).reshape(-1, 2, fp.LIMBS))
+    return [tuple(flat[3 * i : 3 * i + 3]) for i in range(len(flat) // 3)]
+
+
+def rand_fp12(n, seed=0):
+    hs = rand_fp6(2 * n, seed)
+    return [tuple(hs[2 * i : 2 * i + 2]) for i in range(n)]
+
+
+# G1/G2 affine point conversions (oracle affine ints <-> device mont limbs)
+
+
+def g1_to_dev(pts):
+    """List of oracle G1 affine (x, y) -> pair of (N, 32) mont limb arrays."""
+    xs = fp_to_dev([p[0] for p in pts])
+    ys = fp_to_dev([p[1] for p in pts])
+    return xs, ys
+
+
+def g1_from_jac_dev(pt):
+    """Device Jacobian G1 point -> list of oracle affine points (None=inf)."""
+    from lodestar_tpu.ops import curve as cv
+
+    X, Y, Z = (np.asarray(c) for c in pt)
+    zs = fp_from_dev(Z)
+    aff = cv.jac_to_affine_batch(cv.F1, tuple(map(np.asarray, (X, Y, Z))))
+    xs, ys = fp_from_dev(np.asarray(aff[0])), fp_from_dev(np.asarray(aff[1]))
+    return [None if z == 0 else (x, y) for x, y, z in zip(xs, ys, zs)]
+
+
+def g2_to_dev(pts):
+    """List of oracle G2 affine ((x0,x1),(y0,y1)) -> pair of (N,2,32) arrays."""
+    xs = fp2_to_dev([p[0] for p in pts])
+    ys = fp2_to_dev([p[1] for p in pts])
+    return xs, ys
+
+
+def g2_from_jac_dev(pt):
+    from lodestar_tpu.ops import curve as cv
+
+    X, Y, Z = (np.asarray(c) for c in pt)
+    z_zero = [all(c0 == 0 and c1 == 0 for c0, c1 in [v]) for v in fp2_from_dev(Z)]
+    aff = cv.jac_to_affine_batch(cv.F2, tuple(map(np.asarray, (X, Y, Z))))
+    xs, ys = fp2_from_dev(np.asarray(aff[0])), fp2_from_dev(np.asarray(aff[1]))
+    return [None if z else (x, y) for x, y, z in zip(xs, ys, z_zero)]

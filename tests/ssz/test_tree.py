@@ -1,0 +1,198 @@
+"""Tests: incremental merkle tree + tree views vs the plain scalar path.
+
+The invariant everywhere: a tree view's hash_tree_root must equal the
+plain `type.hash_tree_root(value)` for the equivalent value, while costing
+only O(dirty * depth) hashing after mutations (asserted indirectly via
+node-identity sharing).
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.ssz import tree as T
+from lodestar_tpu.ssz.batch import batch_container_roots, pack_basic_chunks
+from lodestar_tpu.ssz.types import (
+    Container,
+    ContainerValue,
+    List,
+    uint64,
+    Bytes32,
+    Bytes48,
+    boolean,
+)
+from lodestar_tpu.types import ssz_types
+
+
+Checkpoint = Container("Checkpoint", [("epoch", uint64), ("root", Bytes32)])
+MiniValidator = Container(
+    "MiniValidator",
+    [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("effective_balance", uint64),
+        ("slashed", boolean),
+        ("activation_eligibility_epoch", uint64),
+        ("activation_epoch", uint64),
+        ("exit_epoch", uint64),
+        ("withdrawable_epoch", uint64),
+    ],
+)
+
+
+def mk_validator(i):
+    return ContainerValue(
+        MiniValidator,
+        pubkey=bytes([i % 251]) * 48,
+        withdrawal_credentials=bytes([i % 7]) * 32,
+        effective_balance=32_000_000_000 + i,
+        slashed=(i % 5 == 0),
+        activation_eligibility_epoch=i,
+        activation_epoch=i + 1,
+        exit_epoch=2**64 - 1,
+        withdrawable_epoch=2**64 - 1,
+    )
+
+
+class TestBatchRoots:
+    def test_batch_container_roots_match_scalar(self):
+        vals = [mk_validator(i) for i in range(10)]
+        got = batch_container_roots(MiniValidator, vals)
+        assert got is not None
+        for i, v in enumerate(vals):
+            assert got[i].tobytes() == MiniValidator.hash_tree_root(v)
+
+    def test_pack_basic_chunks_matches_serialize(self):
+        vals = [2**63 + i for i in range(9)]
+        chunks = pack_basic_chunks(uint64, vals)
+        expect = b"".join(uint64.serialize(v) for v in vals)
+        assert chunks.tobytes()[: len(expect)] == expect
+        assert chunks.tobytes()[len(expect) :] == b"\x00" * (chunks.size - len(expect))
+
+
+class TestNodeTree:
+    def test_subtree_and_compute_root_match_merkleize(self):
+        from lodestar_tpu.ssz.merkle import merkleize
+
+        rng = np.random.default_rng(0)
+        chunks = rng.integers(0, 256, size=(5, 32), dtype=np.uint8)
+        node = T.subtree_from_chunks(chunks, 3)
+        assert T.compute_root(node) == merkleize(chunks, limit=8)
+
+    def test_set_node_structural_sharing(self):
+        rng = np.random.default_rng(1)
+        chunks = rng.integers(0, 256, size=(8, 32), dtype=np.uint8)
+        root = T.subtree_from_chunks(chunks, 3)
+        T.compute_root(root)
+        new = T.set_node(root, (1 << 3) + 5, T.leaf(b"\x42" * 32))
+        # untouched subtrees are the SAME objects (structural sharing);
+        # leaf 5 path = right, left, right
+        assert new.left is root.left
+        assert new.right.right is root.right.right
+        assert new.right.left.left is root.right.left.left
+        # only the path to leaf 5 is unhashed
+        assert new._root is None and new.right._root is None and new.right.left._root is None
+
+    def test_zero_node_roots(self):
+        from lodestar_tpu.ssz.hash import ZERO_HASHES
+
+        for d in (0, 1, 5, 40):
+            assert T.compute_root(T.zero_node(d)) == ZERO_HASHES[d]
+
+
+class TestBasicListView:
+    LT = List(uint64, 2**40)
+
+    def test_root_matches_plain(self):
+        vals = [1000 + i for i in range(100)]
+        view = T.tree_view(self.LT, vals)
+        assert view.hash_tree_root() == self.LT.hash_tree_root(vals)
+
+    def test_set_and_push(self):
+        vals = [7 * i for i in range(10)]
+        view = T.tree_view(self.LT, vals)
+        view.set(3, 999)
+        view.push(12345)
+        expect = list(vals)
+        expect[3] = 999
+        expect.append(12345)
+        assert view.hash_tree_root() == self.LT.hash_tree_root(expect)
+        assert view.get(3) == 999
+        assert view.to_value() == expect
+
+    def test_empty(self):
+        view = T.tree_view(self.LT, [])
+        assert view.hash_tree_root() == self.LT.hash_tree_root([])
+
+
+class TestCompositeListView:
+    LT = List(MiniValidator, 2**40)
+
+    def test_root_matches_plain(self):
+        vals = [mk_validator(i) for i in range(33)]
+        view = T.tree_view(self.LT, vals)
+        assert view.hash_tree_root() == self.LT.hash_tree_root(vals)
+
+    def test_incremental_update(self):
+        vals = [mk_validator(i) for i in range(20)]
+        view = T.tree_view(self.LT, vals)
+        view.hash_tree_root()
+        v2 = mk_validator(99)
+        view.set(11, v2)
+        view.push(mk_validator(123))
+        expect = list(vals)
+        expect[11] = v2
+        expect.append(mk_validator(123))
+        assert view.hash_tree_root() == self.LT.hash_tree_root(expect)
+
+
+class TestContainerView:
+    def test_beacon_state_root_incremental(self):
+        from lodestar_tpu import params
+        t = ssz_types(params.MINIMAL)
+        state_t = t.phase0.BeaconState
+        state = state_t.default()
+        # populate a few validators + balances
+        state.validators = [mk_validator_real(t, i) for i in range(8)]
+        state.balances = [32_000_000_000] * 8
+        state.slot = 12345
+
+        view = T.tree_view(state_t, state.copy())
+        root0 = view.hash_tree_root()
+        assert root0 == state_t.hash_tree_root(state)
+
+        # mutate through the view: one balance + the slot
+        view.view("balances").set(2, 31_000_000_000)
+        view.set("slot", 12346)
+        mutated = state.copy()
+        mutated.balances[2] = 31_000_000_000
+        mutated.slot = 12346
+        assert view.hash_tree_root() == state_t.hash_tree_root(mutated)
+
+    def test_validator_mutation_through_view(self):
+        from lodestar_tpu import params
+        t = ssz_types(params.MINIMAL)
+        state_t = t.phase0.BeaconState
+        state = state_t.default()
+        state.validators = [mk_validator_real(t, i) for i in range(4)]
+        state.balances = [1, 2, 3, 4]
+
+        view = T.tree_view(state_t, state.copy())
+        view.hash_tree_root()
+        newv = mk_validator_real(t, 7)
+        view.view("validators").set(1, newv)
+        mutated = state.copy()
+        mutated.validators[1] = newv
+        assert view.hash_tree_root() == state_t.hash_tree_root(mutated)
+
+
+def mk_validator_real(t, i):
+    v = t.Validator.default()
+    v.pubkey = bytes([i % 251]) * 48
+    v.withdrawal_credentials = bytes([i % 13]) * 32
+    v.effective_balance = 32_000_000_000
+    v.slashed = False
+    v.activation_eligibility_epoch = i
+    v.activation_epoch = i
+    v.exit_epoch = 2**64 - 1
+    v.withdrawable_epoch = 2**64 - 1
+    return v
