@@ -16,6 +16,7 @@ from types import SimpleNamespace
 
 from lodestar_tpu import ssz
 from lodestar_tpu.params import (
+    ATTESTATION_SUBNET_COUNT,
     BeaconPreset,
     DEPOSIT_CONTRACT_TREE_DEPTH,
     JUSTIFICATION_BITS_LENGTH,
@@ -160,6 +161,58 @@ def _build_types(p: BeaconPreset) -> SimpleNamespace:
     t.SignedAggregateAndProof = _C(
         "SignedAggregateAndProof", [("message", t.AggregateAndProof), ("signature", B96)]
     )
+    # duty/API helper (reference phase0/sszTypes.ts CommitteeAssignment)
+    t.CommitteeAssignment = _C(
+        "CommitteeAssignment",
+        [
+            ("validators", _L(u64, p.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("committee_index", u64),
+            ("slot", u64),
+        ],
+    )
+    # the reference exports the same shape under both names (Validator is
+    # its node-struct variant of ValidatorContainer)
+    t.ValidatorContainer = t.Validator
+
+    # --- p2p / reqresp containers (reference phase0+altair sszTypes.ts) ---
+    t.ENRForkID = _C(
+        "ENRForkID",
+        [("fork_digest", B4), ("next_fork_version", B4), ("next_fork_epoch", u64)],
+    )
+    t.Status = _C(
+        "Status",
+        [
+            ("fork_digest", B4),
+            ("finalized_root", B32),
+            ("finalized_epoch", u64),
+            ("head_root", B32),
+            ("head_slot", u64),
+        ],
+    )
+    t.BeaconBlocksByRangeRequest = _C(
+        "BeaconBlocksByRangeRequest", [("start_slot", u64), ("count", u64), ("step", u64)]
+    )
+    t.Genesis = _C(
+        "Genesis",
+        [("genesis_validators_root", B32), ("genesis_time", u64), ("genesis_fork_version", B4)],
+    )
+    t.Eth1Block = _C(
+        "Eth1Block", [("timestamp", u64), ("deposit_root", B32), ("deposit_count", u64)]
+    )
+    t.Eth1DataOrdered = _C(
+        "Eth1DataOrdered",
+        [("deposit_root", B32), ("deposit_count", u64), ("block_hash", B32), ("block_number", u64)],
+    )
+    t.DepositEvent = _C(
+        "DepositEvent", [("deposit_data", t.DepositData), ("block_number", u64), ("index", u64)]
+    )
+    t.HistoricalBatchRoots = _C(
+        "HistoricalBatchRoots",
+        [
+            ("block_roots", _V(B32, p.SLOTS_PER_HISTORICAL_ROOT)),
+            ("state_roots", _V(B32, p.SLOTS_PER_HISTORICAL_ROOT)),
+        ],
+    )
 
     # --- phase0 block + state ---
     phase0_body_fields = [
@@ -173,6 +226,10 @@ def _build_types(p: BeaconPreset) -> SimpleNamespace:
         ("voluntary_exits", _L(t.SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS)),
     ]
     phase0 = SimpleNamespace()
+    phase0.Metadata = _C(
+        "MetadataPhase0",
+        [("seq_number", u64), ("attnets", ssz.Bitvector(ATTESTATION_SUBNET_COUNT))],
+    )
     phase0.BeaconBlockBody = _C("BeaconBlockBodyPhase0", list(phase0_body_fields))
     phase0.BeaconBlock = _C(
         "BeaconBlockPhase0",
@@ -268,6 +325,17 @@ def _build_types(p: BeaconPreset) -> SimpleNamespace:
     t.SignedContributionAndProof = _C(
         "SignedContributionAndProof", [("message", t.ContributionAndProof), ("signature", B96)]
     )
+    t.SyncAggregatorSelectionData = _C(
+        "SyncAggregatorSelectionData", [("slot", u64), ("subcommittee_index", u64)]
+    )
+    altair.Metadata = _C(
+        "MetadataAltair",
+        [
+            ("seq_number", u64),
+            ("attnets", ssz.Bitvector(ATTESTATION_SUBNET_COUNT)),
+            ("syncnets", ssz.Bitvector(SYNC_COMMITTEE_SUBNET_COUNT)),
+        ],
+    )
 
     altair_body_fields = phase0_body_fields + [("sync_aggregate", t.SyncAggregate)]
     altair.BeaconBlockBody = _C("BeaconBlockBodyAltair", list(altair_body_fields))
@@ -339,6 +407,9 @@ def _build_types(p: BeaconPreset) -> SimpleNamespace:
             ("signature_slot", u64),
         ],
     )
+    t.LightClientUpdatesByRange = _C(
+        "LightClientUpdatesByRange", [("start_period", u64), ("count", u64)]
+    )
 
     # --- bellatrix ---
     bellatrix = SimpleNamespace()
@@ -386,6 +457,69 @@ def _build_types(p: BeaconPreset) -> SimpleNamespace:
         + phase0_state_suffix
         + altair_state_tail
         + [("latest_execution_payload_header", bellatrix.ExecutionPayloadHeader)],
+    )
+
+    # engine-api / builder-api containers (reference bellatrix/sszTypes.ts)
+    bellatrix.CommonExecutionPayloadType = _C(
+        "CommonExecutionPayloadType", payload_prefix[:-1]
+    )
+    bellatrix.PowBlock = _C(
+        "PowBlock", [("block_hash", B32), ("parent_hash", B32), ("total_difficulty", u256)]
+    )
+    payload_attr_fields = [
+        ("timestamp", u64),
+        ("prev_randao", B32),
+        ("suggested_fee_recipient", B20),
+    ]
+    bellatrix.PayloadAttributes = _C("PayloadAttributesBellatrix", list(payload_attr_fields))
+    sse_payload_attr_common = [
+        ("proposer_index", u64),
+        ("proposal_slot", u64),
+        ("proposal_block_number", u64),
+        ("parent_block_root", B32),
+        ("parent_block_hash", B32),
+    ]
+    bellatrix.SSEPayloadAttributesCommon = _C(
+        "SSEPayloadAttributesCommon", list(sse_payload_attr_common)
+    )
+    bellatrix.SSEPayloadAttributes = _C(
+        "SSEPayloadAttributesBellatrix",
+        sse_payload_attr_common + [("payload_attributes", bellatrix.PayloadAttributes)],
+    )
+    t.ValidatorRegistrationV1 = _C(
+        "ValidatorRegistrationV1",
+        [("fee_recipient", B20), ("gas_limit", u64), ("timestamp", u64), ("pubkey", B48)],
+    )
+    t.SignedValidatorRegistrationV1 = _C(
+        "SignedValidatorRegistrationV1",
+        [("message", t.ValidatorRegistrationV1), ("signature", B96)],
+    )
+    bellatrix.ValidatorRegistrationV1 = t.ValidatorRegistrationV1
+    bellatrix.SignedValidatorRegistrationV1 = t.SignedValidatorRegistrationV1
+    bellatrix.BuilderBid = _C(
+        "BuilderBidBellatrix",
+        [("header", bellatrix.ExecutionPayloadHeader), ("value", u256), ("pubkey", B48)],
+    )
+    bellatrix.SignedBuilderBid = _C(
+        "SignedBuilderBidBellatrix", [("message", bellatrix.BuilderBid), ("signature", B96)]
+    )
+    blinded_block_prefix = [
+        ("slot", u64),
+        ("proposer_index", u64),
+        ("parent_root", B32),
+        ("state_root", B32),
+    ]
+    bellatrix.BlindedBeaconBlockBody = _C(
+        "BlindedBeaconBlockBodyBellatrix",
+        altair_body_fields + [("execution_payload_header", bellatrix.ExecutionPayloadHeader)],
+    )
+    bellatrix.BlindedBeaconBlock = _C(
+        "BlindedBeaconBlockBellatrix",
+        blinded_block_prefix + [("body", bellatrix.BlindedBeaconBlockBody)],
+    )
+    bellatrix.SignedBlindedBeaconBlock = _C(
+        "SignedBlindedBeaconBlockBellatrix",
+        [("message", bellatrix.BlindedBeaconBlock), ("signature", B96)],
     )
     t.bellatrix = bellatrix
 
@@ -445,29 +579,70 @@ def _build_types(p: BeaconPreset) -> SimpleNamespace:
             ("historical_summaries", _L(t.HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT)),
         ],
     )
+
+    # engine-api / builder-api containers (reference capella/sszTypes.ts)
+    capella.PayloadAttributes = _C(
+        "PayloadAttributesCapella", payload_attr_fields + [("withdrawals", withdrawals)]
+    )
+    capella.SSEPayloadAttributes = _C(
+        "SSEPayloadAttributesCapella",
+        sse_payload_attr_common + [("payload_attributes", capella.PayloadAttributes)],
+    )
+    capella.BuilderBid = _C(
+        "BuilderBidCapella",
+        [("header", capella.ExecutionPayloadHeader), ("value", u256), ("pubkey", B48)],
+    )
+    capella.SignedBuilderBid = _C(
+        "SignedBuilderBidCapella", [("message", capella.BuilderBid), ("signature", B96)]
+    )
+    capella.BlindedBeaconBlockBody = _C(
+        "BlindedBeaconBlockBodyCapella",
+        altair_body_fields
+        + [
+            ("execution_payload_header", capella.ExecutionPayloadHeader),
+            ("bls_to_execution_changes", _L(t.SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)),
+        ],
+    )
+    capella.BlindedBeaconBlock = _C(
+        "BlindedBeaconBlockCapella",
+        blinded_block_prefix + [("body", capella.BlindedBeaconBlockBody)],
+    )
+    capella.SignedBlindedBeaconBlock = _C(
+        "SignedBlindedBeaconBlockCapella",
+        [("message", capella.BlindedBeaconBlock), ("signature", B96)],
+    )
+    capella.LightClientHeader = _C(
+        "LightClientHeaderCapella",
+        [
+            ("beacon", t.BeaconBlockHeader),
+            ("execution", capella.ExecutionPayloadHeader),
+            ("execution_branch", _V(B32, 4)),
+        ],
+    )
     t.capella = capella
 
     # --- deneb ---
+    # NOTE: the reference v1.8.0 implements the EARLY EIP-4844 spec — one
+    # `excess_data_gas: uint256` field (deneb/sszTypes.ts:120-134), not the
+    # final `blob_gas_used`/`excess_blob_gas` pair. Parity follows the
+    # reference.
     deneb = SimpleNamespace()
-    deneb_payload_prefix = payload_prefix + []
     deneb.ExecutionPayload = _C(
         "ExecutionPayloadDeneb",
-        deneb_payload_prefix
+        payload_prefix
         + [
             ("transactions", transactions),
             ("withdrawals", withdrawals),
-            ("blob_gas_used", u64),
-            ("excess_blob_gas", u64),
+            ("excess_data_gas", u256),
         ],
     )
     deneb.ExecutionPayloadHeader = _C(
         "ExecutionPayloadHeaderDeneb",
-        deneb_payload_prefix
+        payload_prefix
         + [
             ("transactions_root", B32),
             ("withdrawals_root", B32),
-            ("blob_gas_used", u64),
-            ("excess_blob_gas", u64),
+            ("excess_data_gas", u256),
         ],
     )
     deneb_body_fields = altair_body_fields + [
@@ -514,6 +689,92 @@ def _build_types(p: BeaconPreset) -> SimpleNamespace:
             ("blob", t.Blob),
             ("kzg_commitment", B48),
             ("kzg_proof", B48),
+        ],
+    )
+    deneb.BlobSidecar = t.BlobSidecar
+    deneb.SignedBlobSidecar = _C(
+        "SignedBlobSidecar", [("message", t.BlobSidecar), ("signature", B96)]
+    )
+    deneb.BlindedBlobSidecar = _C(
+        "BlindedBlobSidecar",
+        [
+            ("block_root", B32),
+            ("index", u64),
+            ("slot", u64),
+            ("block_parent_root", B32),
+            ("proposer_index", u64),
+            ("blob_root", B32),
+            ("kzg_commitment", B48),
+            ("kzg_proof", B48),
+        ],
+    )
+    deneb.SignedBlindedBlobSidecar = _C(
+        "SignedBlindedBlobSidecar", [("message", deneb.BlindedBlobSidecar), ("signature", B96)]
+    )
+    blobs = _L(t.Blob, p.MAX_BLOBS_PER_BLOCK)
+    deneb.BlobsAndCommitments = _C(
+        "BlobsAndCommitments",
+        [("blobs", blobs), ("kzg_commitments", _L(B48, p.MAX_BLOBS_PER_BLOCK))],
+    )
+    deneb.PolynomialAndCommitment = _C(
+        "PolynomialAndCommitment",
+        [("polynomial", _L(B32, p.FIELD_ELEMENTS_PER_BLOB)), ("kzg_commitment", B48)],
+    )
+    deneb.BlobIdentifier = _C("BlobIdentifier", [("block_root", B32), ("index", u64)])
+    deneb.BlobSidecarsByRangeRequest = _C(
+        "BlobSidecarsByRangeRequest", [("start_slot", u64), ("count", u64)]
+    )
+    deneb.BlobsSidecarsByRangeRequest = _C(
+        "BlobsSidecarsByRangeRequest", [("start_slot", u64), ("count", u64)]
+    )
+    # pre-migration coupled-sidecar containers the reference still carries
+    deneb.BlobsSidecar = _C(
+        "BlobsSidecar",
+        [
+            ("beacon_block_root", B32),
+            ("beacon_block_slot", u64),
+            ("blobs", blobs),
+            ("kzg_aggregated_proof", B48),
+        ],
+    )
+    deneb.SignedBeaconBlockAndBlobsSidecar = _C(
+        "SignedBeaconBlockAndBlobsSidecar",
+        [("beacon_block", deneb.SignedBeaconBlock), ("blobs_sidecar", deneb.BlobsSidecar)],
+    )
+    deneb.BuilderBid = _C(
+        "BuilderBidDeneb",
+        [
+            ("header", deneb.ExecutionPayloadHeader),
+            ("value", u256),
+            ("pubkey", B48),
+            ("blob_kzg_commitments", _L(B48, p.MAX_BLOBS_PER_BLOCK)),
+        ],
+    )
+    deneb.SignedBuilderBid = _C(
+        "SignedBuilderBidDeneb", [("message", deneb.BuilderBid), ("signature", B96)]
+    )
+    # NOTE: mirrors the reference v1.8.0 declaration (deneb/sszTypes.ts:233),
+    # which spreads the FULL BeaconBlockBody (including execution_payload)
+    # and appends the header — a quirk of the in-progress deneb code there;
+    # parity keeps it byte-identical.
+    deneb.BlindedBeaconBlockBody = _C(
+        "BlindedBeaconBlockBodyDeneb",
+        deneb_body_fields + [("execution_payload_header", deneb.ExecutionPayloadHeader)],
+    )
+    deneb.BlindedBeaconBlock = _C(
+        "BlindedBeaconBlockDeneb",
+        blinded_block_prefix + [("body", deneb.BlindedBeaconBlockBody)],
+    )
+    deneb.SignedBlindedBeaconBlock = _C(
+        "SignedBlindedBeaconBlockDeneb",
+        [("message", deneb.BlindedBeaconBlock), ("signature", B96)],
+    )
+    deneb.LightClientHeader = _C(
+        "LightClientHeaderDeneb",
+        [
+            ("beacon", t.BeaconBlockHeader),
+            ("execution", deneb.ExecutionPayloadHeader),
+            ("execution_branch", _V(B32, 4)),
         ],
     )
     t.deneb = deneb

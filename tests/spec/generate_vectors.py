@@ -1,0 +1,207 @@
+"""Regenerate the committed BLS spec-test fixture tree.
+
+Writes `tests/spec/vectors/tests/general/phase0/bls/<handler>/small/<case>/data.yaml`
+in the official consensus-spec-tests BLS format (input/output yaml), the
+same tree shape the reference's downloader produces
+(`spec-test-util/src/downloadTests.ts`; runner `test/spec/bls/bls.ts`).
+
+Values are produced by the CPU oracle — which is itself pinned externally
+by the RFC 9380 J.10.1 hash-to-curve KATs (tests/crypto/test_bls_reference.py)
+— so these fixtures serve as (a) golden regression vectors for both the
+oracle and the device path, (b) proof the directory harness runs the
+official layout. Case selection mirrors the official suite's edge cases:
+infinity pubkey/signature, tampered signatures, wrong message, empty
+aggregation, the eth2 infinity fast-aggregate special case.
+
+Usage: python tests/spec/generate_vectors.py
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from lodestar_tpu.crypto.bls.api import (  # noqa: E402
+    SecretKey,
+    aggregate_signatures,
+    aggregate_verify,
+    eth_fast_aggregate_verify,
+    fast_aggregate_verify,
+    sign,
+    verify,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.join(HERE, "vectors", "tests", "general", "phase0", "bls")
+
+G2_INF = bytes([0xC0]) + bytes(95)
+G1_INF = bytes([0xC0]) + bytes(47)
+
+MSGS = [bytes(32), b"\x56" * 32, b"\xab" * 32]
+SKS = [SecretKey(k) for k in (0x263DBD792F5B1BE47ED85F8938C0F29586AF0D3AC7B977F21C278FE1462040E3,
+                              0x47B8192D77BF871B62E87859D653922725724A5C031AFEABC60BCEF5FF665138,
+                              0x328388AFF0D4A5B7DC9205ABD374E7E98F3CD9F3418EDB4EAFDA5FB16473D216)]
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+def _write(handler: str, case: str, data: dict) -> None:
+    d = os.path.join(ROOT, handler, "small", case)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "data.yaml"), "w") as f:
+        yaml.safe_dump(data, f, sort_keys=False)
+
+
+def gen_sign() -> None:
+    i = 0
+    for sk in SKS[:2]:
+        for msg in MSGS[:2]:
+            sig = sign(sk, msg)
+            _write("sign", f"sign_case_{i}", {
+                "input": {"privkey": _hex(sk.scalar.to_bytes(32, "big")), "message": _hex(msg)},
+                "output": _hex(sig),
+            })
+            i += 1
+
+
+def gen_verify() -> None:
+    sk, msg = SKS[0], MSGS[1]
+    pk = sk.to_pubkey()
+    sig = sign(sk, msg)
+    cases = [
+        ("verify_valid", pk, msg, sig, True),
+        ("verify_wrong_message", pk, MSGS[2], sig, False),
+        ("verify_wrong_pubkey", SKS[1].to_pubkey(), msg, sig, False),
+        ("verify_tampered_sig", pk, msg, sign(SKS[1], msg), False),
+        ("verify_infinity_pubkey_and_infinity_signature", G1_INF, msg, G2_INF, False),
+    ]
+    for name, p, m, s, expect in cases:
+        assert verify(p, m, s) is expect, name
+        _write("verify", name, {
+            "input": {"pubkey": _hex(p), "message": _hex(m), "signature": _hex(s)},
+            "output": expect,
+        })
+
+
+def gen_aggregate() -> None:
+    msg = MSGS[1]
+    sigs = [sign(sk, msg) for sk in SKS]
+    _write("aggregate", "aggregate_0x56_signatures", {
+        "input": [_hex(s) for s in sigs],
+        "output": _hex(aggregate_signatures(sigs)),
+    })
+    _write("aggregate", "aggregate_single_signature", {
+        "input": [_hex(sigs[0])],
+        "output": _hex(aggregate_signatures([sigs[0]])),
+    })
+    # empty input -> error (official: output null)
+    _write("aggregate", "aggregate_na_signatures", {"input": [], "output": None})
+
+
+def gen_fast_aggregate_verify() -> None:
+    msg = MSGS[1]
+    pks = [sk.to_pubkey() for sk in SKS]
+    agg = aggregate_signatures([sign(sk, msg) for sk in SKS])
+    cases = [
+        ("fast_aggregate_verify_valid", pks, msg, agg, True),
+        ("fast_aggregate_verify_wrong_message", pks, MSGS[2], agg, False),
+        ("fast_aggregate_verify_extra_pubkey", pks + [SKS[0].to_pubkey()], msg, agg, False),
+        ("fast_aggregate_verify_na_pubkeys_and_infinity_signature", [], msg, G2_INF, False),
+        ("fast_aggregate_verify_infinity_pubkey", pks + [G1_INF], msg, agg, False),
+    ]
+    for name, p, m, s, expect in cases:
+        assert fast_aggregate_verify(p, m, s) is expect, name
+        _write("fast_aggregate_verify", name, {
+            "input": {"pubkeys": [_hex(x) for x in p], "message": _hex(m), "signature": _hex(s)},
+            "output": expect,
+        })
+
+
+def gen_eth_fast_aggregate_verify() -> None:
+    """altair variant: empty pubkeys + infinity signature is VALID."""
+    msg = MSGS[1]
+    pks = [sk.to_pubkey() for sk in SKS]
+    agg = aggregate_signatures([sign(sk, msg) for sk in SKS])
+    cases = [
+        ("eth_fast_aggregate_verify_valid", pks, msg, agg, True),
+        ("eth_fast_aggregate_verify_na_pubkeys_and_infinity_signature", [], msg, G2_INF, True),
+        ("eth_fast_aggregate_verify_na_pubkeys_and_non_infinity_signature", [], msg, agg, False),
+        ("eth_fast_aggregate_verify_extra_pubkey", pks + [SKS[1].to_pubkey()], msg, agg, False),
+    ]
+    for name, p, m, s, expect in cases:
+        assert eth_fast_aggregate_verify(p, m, s) is expect, name
+        _write("eth_fast_aggregate_verify", name, {
+            "input": {"pubkeys": [_hex(x) for x in p], "message": _hex(m), "signature": _hex(s)},
+            "output": expect,
+        })
+
+
+def gen_aggregate_verify() -> None:
+    pks = [sk.to_pubkey() for sk in SKS]
+    sigs = [sign(sk, m) for sk, m in zip(SKS, MSGS)]
+    agg = aggregate_signatures(sigs)
+    cases = [
+        ("aggregate_verify_valid", pks, MSGS, agg, True),
+        ("aggregate_verify_tampered_signature", pks, MSGS, sigs[0], False),
+        ("aggregate_verify_na_pubkeys_and_infinity_signature", [], [], G2_INF, False),
+        ("aggregate_verify_na_pubkeys_and_na_signature", [], [], bytes(96), False),
+    ]
+    for name, p, m, s, expect in cases:
+        assert aggregate_verify(p, list(m), s) is expect, name
+        _write("aggregate_verify", name, {
+            "input": {
+                "pubkeys": [_hex(x) for x in p],
+                "messages": [_hex(x) for x in m],
+                "signature": _hex(s),
+            },
+            "output": expect,
+        })
+
+
+def gen_batch_verify() -> None:
+    """Official `batch_verify` handler shape (pubkeys/messages/signatures
+    triples verified as independent sets) — drives BOTH the oracle and the
+    device batch verifier in the runner."""
+    pks = [sk.to_pubkey() for sk in SKS]
+    sigs = [sign(sk, m) for sk, m in zip(SKS, MSGS)]
+    bad = list(sigs)
+    bad[2] = sign(SKS[0], MSGS[2])
+    cases = [
+        ("batch_verify_valid", pks, MSGS, sigs, True),
+        ("batch_verify_one_tampered", pks, MSGS, bad, False),
+        ("batch_verify_single", pks[:1], MSGS[:1], sigs[:1], True),
+    ]
+    for name, p, m, s, expect in cases:
+        _write("batch_verify", name, {
+            "input": {
+                "pubkeys": [_hex(x) for x in p],
+                "messages": [_hex(x) for x in m],
+                "signatures": [_hex(x) for x in s],
+            },
+            "output": expect,
+        })
+
+
+def main() -> None:
+    if os.path.isdir(ROOT):
+        shutil.rmtree(ROOT)
+    gen_sign()
+    gen_verify()
+    gen_aggregate()
+    gen_fast_aggregate_verify()
+    gen_eth_fast_aggregate_verify()
+    gen_aggregate_verify()
+    gen_batch_verify()
+    n = sum(len(files) for _, _, files in os.walk(ROOT))
+    print(f"wrote {n} fixture files under {ROOT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+"""Independent naive SSZ merkleizer — the cross-check oracle for ssz_static.
+
+Written directly from the consensus SSZ spec (simple-serialize.md), sharing
+NO code with `lodestar_tpu.ssz`: its own chunk packing, its own zero-hash
+ladder, its own recursive merkleization through hashlib. Any divergence
+between this and the production layer is a real bug in one of them — the
+role official ssz_static vectors play in the reference
+(`beacon-node/test/spec/presets/ssz_static.ts`), approximated here because
+the official fixture tarballs are unavailable offline.
+
+Also provides `random_value` to synthesize arbitrary instances of any
+registered type for differential fuzzing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from lodestar_tpu import ssz
+
+CHUNK = 32
+
+
+def _h(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+_ZEROS = [b"\x00" * CHUNK]
+for _ in range(64):
+    _ZEROS.append(_h(_ZEROS[-1], _ZEROS[-1]))
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def _merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
+    n = len(chunks)
+    width = _next_pow2(n if limit is None else limit)
+    if limit is not None and n > limit:
+        raise ValueError("too many chunks")
+    depth = width.bit_length() - 1
+    layer = list(chunks)
+    for d in range(depth):
+        if len(layer) % 2:
+            layer.append(_ZEROS[d])
+        layer = [_h(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)]
+    # an empty input never produced a node: the root is the zero subtree
+    return layer[0] if layer else _ZEROS[depth]
+
+
+def _pack(data: bytes) -> list[bytes]:
+    if not data:
+        return [b"\x00" * CHUNK]
+    pad = (-len(data)) % CHUNK
+    data = data + b"\x00" * pad
+    return [data[i : i + CHUNK] for i in range(0, len(data), CHUNK)]
+
+
+def _mix_len(root: bytes, length: int) -> bytes:
+    return _h(root, length.to_bytes(32, "little"))
+
+
+def _bits_bytes(bits, length: int) -> bytes:
+    out = bytearray((length + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def naive_root(typ, value) -> bytes:
+    """hash_tree_root per the SSZ spec, independent of lodestar_tpu.ssz."""
+    if isinstance(typ, ssz.Uint):
+        return value.to_bytes(typ.byte_len, "little") + b"\x00" * (32 - typ.byte_len)
+    if isinstance(typ, ssz.Boolean):
+        return (b"\x01" if value else b"\x00") + b"\x00" * 31
+    if isinstance(typ, ssz.ByteVector):
+        return _merkleize(_pack(bytes(value)))
+    if isinstance(typ, ssz.ByteList):
+        limit_chunks = max((typ.limit + CHUNK - 1) // CHUNK, 1)
+        root = _merkleize(_pack(bytes(value)), limit=limit_chunks)
+        return _mix_len(root, len(value))
+    if isinstance(typ, ssz.Bitvector):
+        limit_chunks = max((typ.length + 255) // 256, 1)
+        root = _merkleize(_pack(_bits_bytes(value, len(value))), limit=limit_chunks)
+        return root
+    if isinstance(typ, ssz.Bitlist):
+        limit_chunks = max((typ.limit + 255) // 256, 1)
+        root = _merkleize(_pack(_bits_bytes(value, len(value))), limit=limit_chunks)
+        return _mix_len(root, len(value))
+    if isinstance(typ, ssz.Vector):
+        if _is_basic(typ.elem):
+            data = b"".join(typ.elem.serialize(v) for v in value)
+            return _merkleize(_pack(data))
+        return _merkleize([naive_root(typ.elem, v) for v in value])
+    if isinstance(typ, ssz.List):
+        if _is_basic(typ.elem):
+            elem_size = typ.elem.fixed_size()
+            limit_chunks = max((typ.limit * elem_size + CHUNK - 1) // CHUNK, 1)
+            data = b"".join(typ.elem.serialize(v) for v in value)
+            root = _merkleize(_pack(data) if value else [], limit=limit_chunks)
+            return _mix_len(root, len(value))
+        roots = [naive_root(typ.elem, v) for v in value]
+        return _mix_len(_merkleize(roots, limit=max(typ.limit, 1)), len(value))
+    if isinstance(typ, ssz.Container):
+        return _merkleize([naive_root(ft, getattr(value, fn)) for fn, ft in typ.fields])
+    raise TypeError(f"naive_root: unsupported type {typ!r}")
+
+
+def _is_basic(typ) -> bool:
+    return isinstance(typ, (ssz.Uint, ssz.Boolean))
+
+
+def random_value(typ, rng, list_len: int | None = None):
+    """Arbitrary instance of `typ` (rng: random.Random)."""
+    if isinstance(typ, ssz.Uint):
+        return rng.getrandbits(typ.byte_len * 8)
+    if isinstance(typ, ssz.Boolean):
+        return rng.random() < 0.5
+    if isinstance(typ, ssz.ByteVector):
+        return rng.randbytes(typ.length)
+    if isinstance(typ, ssz.ByteList):
+        n = rng.randint(0, min(typ.limit, 70))
+        return rng.randbytes(n)
+    if isinstance(typ, ssz.Bitvector):
+        return [rng.random() < 0.5 for _ in range(typ.length)]
+    if isinstance(typ, ssz.Bitlist):
+        n = rng.randint(0, min(typ.limit, 70))
+        return [rng.random() < 0.5 for _ in range(n)]
+    if isinstance(typ, ssz.Vector):
+        return [random_value(typ.elem, rng) for _ in range(typ.length)]
+    if isinstance(typ, ssz.List):
+        n = list_len if list_len is not None else rng.randint(0, min(typ.limit, 4))
+        return [random_value(typ.elem, rng) for _ in range(n)]
+    if isinstance(typ, ssz.Container):
+        return ssz.ContainerValue(
+            typ, **{fn: random_value(ft, rng) for fn, ft in typ.fields}
+        )
+    raise TypeError(f"random_value: unsupported type {typ!r}")

@@ -1,0 +1,120 @@
+"""One-time extractor: container field orders from the reference types pkg.
+
+Parses `ContainerType({...})` declarations in
+`/root/reference/packages/types/src/{phase0,altair,bellatrix,capella,deneb}/sszTypes.ts`
+and writes `tests/spec/container_fields.json`: for every named container,
+its camelCase field list converted to snake_case, in declaration order.
+
+This is PARITY DATA (the consensus spec defines these field orders; the
+reference merely transcribes them) — committed to the repo so the
+ssz_static field-order pinning test runs without the reference checkout.
+
+Usage: python tools/extract_ref_fields.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+REF = "/root/reference/packages/types/src"
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "spec", "container_fields.json")
+
+_DECL = re.compile(
+    r"(?:export )?const (\w+)\s*=\s*(new (?:ContainerType|ContainerNodeStructType)\s*\(\s*)?\{",
+)
+# a field line (`name: Type,`), a spread of another container's fields
+# (`...phase0Ssz.BeaconBlockBody.fields,`), or a spread of a local plain
+# field-dict constant (`...executionPayloadFields,`)
+_ITEM = re.compile(r"^\s*(?:(\w+)\s*:|\.\.\.((?:\w+\.)*\w+)(\.fields)?\s*(?:,|$))", re.M)
+_CAMEL = re.compile(r"(?<=[a-z0-9])([A-Z])")
+
+# reference names whose trailing digit is a spec `_N` suffix (attestation_1)
+# rather than part of a word (eth1_data)
+_NUM_SUFFIX = {
+    "attestation1": "attestation_1",
+    "attestation2": "attestation_2",
+    "signedHeader1": "signed_header_1",
+    "signedHeader2": "signed_header_2",
+    "header1": "header_1",
+    "header2": "header_2",
+}
+
+
+def snake(name: str) -> str:
+    # eth1Data -> eth1_data, blsToExecutionChanges -> bls_to_execution_changes
+    if name in _NUM_SUFFIX:
+        return _NUM_SUFFIX[name]
+    return _CAMEL.sub(r"_\1", name).lower()
+
+
+def _match_braces(src: str, open_idx: int) -> int:
+    depth = 0
+    for i in range(open_idx, len(src)):
+        if src[i] == "{":
+            depth += 1
+        elif src[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    raise ValueError("unbalanced braces")
+
+
+def extract(path: str, resolved: dict[str, dict[str, list[str]]], fork: str) -> dict[str, list[str]]:
+    with open(path) as f:
+        src = f.read()
+    # strip comments so commented-out fields don't match
+    src = re.sub(r"/\*.*?\*/", "", src, flags=re.S)
+    src = re.sub(r"//[^\n]*", "", src)
+    out: dict[str, list[str]] = {}
+    plain: dict[str, list[str]] = {}  # bare `const xs = {field: ...}` dicts
+    for m in _DECL.finditer(src):
+        name, is_container = m.group(1), bool(m.group(2))
+        open_idx = m.end() - 1
+        body = src[open_idx + 1 : _match_braces(src, open_idx)]
+        # one item per line so single-line declarations parse too
+        body = body.replace(",", ",\n")
+        # JS object semantics: re-assigning an existing key overrides the
+        # value but KEEPS the key's original position — exactly what dict
+        # assignment does, so collect into a dict keyed by field name.
+        fields_d: dict[str, None] = {}
+        for fm in _ITEM.finditer(body):
+            if fm.group(1):
+                fields_d[snake(fm.group(1))] = None
+            else:
+                # resolve `forkSsz.Name.fields` / local `Name.fields` /
+                # local plain dict spread `...fieldsConst`
+                parts = fm.group(2).split(".")
+                if parts[-1] == "fields":  # greedy match swallowed `.fields`
+                    parts = parts[:-1]
+                tname = parts[-1]
+                src_fork = parts[0].removesuffix("Ssz") if len(parts) > 1 else fork
+                base = (
+                    resolved.get(src_fork, {}).get(tname)
+                    or out.get(tname)
+                    or plain.get(tname)
+                )
+                if base is None:
+                    raise KeyError(f"{path}: spread of unknown {fm.group(2)}")
+                for f in base:
+                    fields_d[f] = None
+        if not fields_d:
+            continue
+        (out if is_container else plain)[name] = list(fields_d)
+    return out
+
+
+def main() -> None:
+    result: dict[str, dict[str, list[str]]] = {}
+    for fork in ("phase0", "altair", "bellatrix", "capella", "deneb"):
+        result[fork] = extract(os.path.join(REF, fork, "sszTypes.ts"), result, fork)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+    total = sum(len(v) for v in result.values())
+    print(f"wrote {total} containers to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
