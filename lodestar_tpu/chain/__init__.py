@@ -1,0 +1,1 @@
+"""Beacon chain runtime layer (reference `beacon-node/src/chain/`)."""

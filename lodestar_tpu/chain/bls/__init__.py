@@ -1,0 +1,17 @@
+"""BLS verification seam + device pool (reference `chain/bls/`)."""
+
+from .interface import (  # noqa: F401
+    BlsSingleThreadVerifier,
+    BlsVerifierMock,
+    IBlsVerifier,
+    VerifySignatureOpts,
+)
+from .pool import (  # noqa: F401
+    BATCHABLE_MIN_PER_CHUNK,
+    MAX_BUFFER_WAIT_MS,
+    MAX_BUFFERED_SIGS,
+    MAX_JOBS_CAN_ACCEPT_WORK,
+    MAX_SIGNATURE_SETS_PER_JOB,
+    BlsDeviceVerifierPool,
+    chunkify_maximize_chunk_size,
+)

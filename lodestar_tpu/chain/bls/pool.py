@@ -1,0 +1,268 @@
+"""Device BLS verifier pool: buffering, chunking, retry, fail-closed.
+
+Asyncio re-design of `BlsMultiThreadWorkerPool`
+(reference `beacon-node/src/chain/bls/multithread/index.ts:103`) with the
+N-worker thread pool replaced by one device pipeline:
+
+* **Buffering** (`index.ts:277-291`): batchable jobs accumulate up to
+  MAX_BUFFER_WAIT_MS (100 ms) or MAX_BUFFERED_SIGS (32), then flush as one
+  batch — gossip bursts amortize into single device launches.
+* **Chunking** (`index.ts:34-39`): big arrays (sync submits ~8k sets) are
+  split ≤ MAX_SIGNATURE_SETS_PER_JOB (128) per job; jobs queue
+  independently so a long sync batch never head-of-line-blocks gossip.
+* **Batch-then-retry** (`worker.ts:52-96`): batchable chunks ≥
+  BATCHABLE_MIN_PER_CHUNK are RLC-batch-verified; an invalid batch is
+  re-verified per-job so one bad signature can't poison its neighbors.
+  `batch_retries` / `batch_sigs_success` counters keep the reference's
+  metric semantics.
+* **Fail-closed** (`index.ts:386-393` analogue): any backend error rejects
+  the job with the error — it never resolves True. Callers treat rejection
+  as invalid-block/peer-downscore, exactly like the reference.
+* **Admission** (`index.ts:143-149`): can_accept_work() false once
+  MAX_JOBS_CAN_ACCEPT_WORK (512) jobs are outstanding — backpressure
+  signal for the gossip processor.
+
+The verify backend is injected as a callable (default: the device model
+`models.batch_verify.verify_signature_sets_device`), which keeps the seam
+mockable and lets tests drive the retry paths deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, Sequence
+
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.logger import get_logger
+
+from .interface import IBlsVerifier, VerifySignatureOpts
+
+__all__ = [
+    "BlsDeviceVerifierPool",
+    "chunkify_maximize_chunk_size",
+    "MAX_SIGNATURE_SETS_PER_JOB",
+    "MAX_BUFFERED_SIGS",
+    "MAX_BUFFER_WAIT_MS",
+    "MAX_JOBS_CAN_ACCEPT_WORK",
+    "BATCHABLE_MIN_PER_CHUNK",
+]
+
+# tuning constants — same values/rationale as the reference (index.ts:30-62)
+MAX_SIGNATURE_SETS_PER_JOB = 128
+MAX_BUFFERED_SIGS = 32
+MAX_BUFFER_WAIT_MS = 100
+MAX_JOBS_CAN_ACCEPT_WORK = 512
+BATCHABLE_MIN_PER_CHUNK = 16  # worker.ts:11-17
+
+
+def chunkify_maximize_chunk_size(arr: Sequence, max_len: int) -> list[list]:
+    """Split into the fewest chunks of size ≤ max_len, sizes as equal as
+    possible (reference `multithread/utils.ts` chunkifyMaximizeChunkSize)."""
+    if not arr:
+        return []
+    n_chunks = (len(arr) + max_len - 1) // max_len
+    base = len(arr) // n_chunks
+    extra = len(arr) % n_chunks
+    out, pos = [], 0
+    for i in range(n_chunks):
+        size = base + (1 if i < extra else 0)
+        out.append(list(arr[pos : pos + size]))
+        pos += size
+    return out
+
+
+class _Job:
+    __slots__ = ("sets", "batchable", "future", "added_ms")
+
+    def __init__(self, sets: list[SignatureSet], batchable: bool):
+        self.sets = sets
+        self.batchable = batchable
+        self.future: asyncio.Future[bool] = asyncio.get_event_loop().create_future()
+        self.added_ms = time.monotonic() * 1000.0
+
+
+class BlsDeviceVerifierPool(IBlsVerifier):
+    def __init__(
+        self,
+        verify_fn: Callable[[list[SignatureSet]], bool] | None = None,
+        *,
+        buffer_wait_ms: float = MAX_BUFFER_WAIT_MS,
+        max_buffered_sigs: int = MAX_BUFFERED_SIGS,
+    ) -> None:
+        if verify_fn is None:
+            from lodestar_tpu.models.batch_verify import verify_signature_sets_device
+
+            verify_fn = verify_signature_sets_device
+        self._verify_fn = verify_fn
+        self._buffer_wait_ms = buffer_wait_ms
+        self._max_buffered_sigs = max_buffered_sigs
+        self._log = get_logger(name="lodestar.bls-pool")
+
+        self._jobs: asyncio.Queue[_Job] = asyncio.Queue()
+        self._outstanding = 0
+        self._buffered: list[_Job] = []
+        self._buffered_sigs = 0
+        self._buffer_timer: asyncio.TimerHandle | None = None
+        self._closed = False
+        self._runner: asyncio.Task | None = None
+
+        # metric counters (reference blsThreadPool.* taxonomy)
+        self.metrics = {
+            "jobs_started": 0,
+            "sig_sets_started": 0,
+            "batch_retries": 0,
+            "batch_sigs_success": 0,
+            "errors": 0,
+        }
+
+    # -- IBlsVerifier ---------------------------------------------------------
+
+    def can_accept_work(self) -> bool:
+        return not self._closed and self._outstanding < MAX_JOBS_CAN_ACCEPT_WORK
+
+    async def verify_signature_sets(
+        self, sets: list[SignatureSet], opts: VerifySignatureOpts | None = None
+    ) -> bool:
+        if self._closed:
+            raise RuntimeError("verifier pool is closed")
+        if not sets:
+            raise ValueError("empty signature-set array")
+        opts = opts or VerifySignatureOpts()
+
+        if opts.verify_on_main_thread:
+            # inline path for cheap time-critical single sets
+            from lodestar_tpu.crypto.bls.api import verify_signature_sets
+
+            return verify_signature_sets(sets)
+
+        self._ensure_runner()
+        jobs = [
+            self._enqueue(_Job(chunk, opts.batchable))
+            for chunk in chunkify_maximize_chunk_size(sets, MAX_SIGNATURE_SETS_PER_JOB)
+        ]
+        results = await asyncio.gather(*(j.future for j in jobs))
+        return all(results)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._buffer_timer is not None:
+            self._buffer_timer.cancel()
+        err = asyncio.CancelledError("bls pool closed")
+        for job in self._buffered:
+            if not job.future.done():
+                job.future.set_exception(err)
+        self._buffered.clear()
+        while not self._jobs.empty():
+            job = self._jobs.get_nowait()
+            if not job.future.done():
+                job.future.set_exception(err)
+        if self._runner is not None:
+            self._runner.cancel()
+            try:
+                await self._runner
+            except asyncio.CancelledError:
+                pass
+            self._runner = None
+
+    # -- queueing -------------------------------------------------------------
+
+    def _ensure_runner(self) -> None:
+        if self._runner is None or self._runner.done():
+            self._runner = asyncio.get_event_loop().create_task(self._run_jobs())
+
+    def _enqueue(self, job: _Job) -> _Job:
+        self._outstanding += 1
+        job.future.add_done_callback(lambda _f: self._dec_outstanding())
+        if job.batchable:
+            self._buffered.append(job)
+            self._buffered_sigs += len(job.sets)
+            if self._buffered_sigs > self._max_buffered_sigs:
+                self._flush_buffer()
+            elif self._buffer_timer is None:
+                loop = asyncio.get_event_loop()
+                self._buffer_timer = loop.call_later(
+                    self._buffer_wait_ms / 1000.0, self._flush_buffer
+                )
+        else:
+            self._jobs.put_nowait(job)
+        return job
+
+    def _dec_outstanding(self) -> None:
+        self._outstanding -= 1
+
+    def _flush_buffer(self) -> None:
+        if self._buffer_timer is not None:
+            self._buffer_timer.cancel()
+            self._buffer_timer = None
+        jobs, self._buffered = self._buffered, []
+        self._buffered_sigs = 0
+        for job in jobs:
+            self._jobs.put_nowait(job)
+
+    # -- execution ------------------------------------------------------------
+
+    async def _run_jobs(self) -> None:
+        while not self._closed:
+            job = await self._jobs.get()
+            # drain whatever is immediately available into one work package
+            package = [job]
+            while not self._jobs.empty():
+                package.append(self._jobs.get_nowait())
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._verify_package, package
+                )
+            except Exception as e:  # fail closed: reject, never resolve True
+                self.metrics["errors"] += len(package)
+                self._log.error(f"bls verify package failed: {e!r}")
+                for j in package:
+                    if not j.future.done():
+                        j.future.set_exception(e)
+
+    def _verify_package(self, package: list[_Job]) -> None:
+        """Runs in a thread executor (device dispatch releases the GIL)."""
+        self.metrics["jobs_started"] += len(package)
+        self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
+
+        batchable = [j for j in package if j.batchable]
+        individual = [j for j in package if not j.batchable]
+
+        # RLC-batch the batchable jobs in ≥16-set chunks; invalid batch →
+        # retry each job individually (worker.ts:52-96)
+        for chunk in chunkify_maximize_chunk_size(batchable, BATCHABLE_MIN_PER_CHUNK):
+            all_sets = [s for j in chunk for s in j.sets]
+            try:
+                ok = self._verify_fn(all_sets)
+            except Exception:
+                self.metrics["batch_retries"] += 1
+                individual.extend(chunk)
+                continue
+            if ok:
+                self.metrics["batch_sigs_success"] += len(all_sets)
+                for j in chunk:
+                    self._resolve(j, True)
+            else:
+                self.metrics["batch_retries"] += 1
+                individual.extend(chunk)
+
+        for j in individual:
+            try:
+                self._resolve(j, self._verify_fn(j.sets))
+            except Exception as e:
+                if not j.future.done():
+                    j.future.get_loop().call_soon_threadsafe(self._reject, j, e)
+
+    def _resolve(self, job: _Job, result: bool) -> None:
+        if not job.future.done():
+            job.future.get_loop().call_soon_threadsafe(self._set_result, job, result)
+
+    @staticmethod
+    def _set_result(job: _Job, result: bool) -> None:
+        if not job.future.done():
+            job.future.set_result(result)
+
+    @staticmethod
+    def _reject(job: _Job, err: Exception) -> None:
+        if not job.future.done():
+            job.future.set_exception(err)

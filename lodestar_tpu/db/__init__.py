@@ -1,0 +1,11 @@
+"""Persistence layer (reference `packages/db/src`): Bucket schema,
+pluggable KV controllers, typed SSZ repositories."""
+
+from .controller import (  # noqa: F401
+    DbController,
+    FileDbController,
+    FilterOptions,
+    MemoryDbController,
+)
+from .repository import Repository  # noqa: F401
+from .schema import BUCKET_LENGTH, Bucket, decode_key_id, encode_key  # noqa: F401

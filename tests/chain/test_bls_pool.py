@@ -1,0 +1,210 @@
+"""Verifier-seam semantics: buffering, chunking, retry-individually,
+fail-closed, backpressure — driven by a deterministic mock backend
+(the reference proves these semantics at `multithread/index.ts` +
+`worker.ts`; the mock keeps the tests device-independent and fast).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls import (
+    BlsDeviceVerifierPool,
+    BlsSingleThreadVerifier,
+    BlsVerifierMock,
+    MAX_JOBS_CAN_ACCEPT_WORK,
+    MAX_SIGNATURE_SETS_PER_JOB,
+    VerifySignatureOpts,
+    chunkify_maximize_chunk_size,
+)
+from lodestar_tpu.crypto.bls.api import SignatureSet
+
+
+def _sets(n: int, tag: int = 0) -> list[SignatureSet]:
+    return [
+        SignatureSet(
+            pubkey=bytes([1, tag, i % 256]) + bytes(45),
+            message=bytes([2, tag, i % 256]) * 8 + bytes(8),
+            signature=bytes([3, tag, i % 256]) + bytes(93),
+        )
+        for i in range(n)
+    ]
+
+
+class Backend:
+    """Scripted verify_fn: records calls; per-set verdicts via a bad-set
+    marker (pubkey[0] == 0xBB)."""
+
+    def __init__(self, raise_on: int | None = None):
+        self.calls: list[int] = []
+        self.raise_on = raise_on
+
+    def __call__(self, sets):
+        self.calls.append(len(sets))
+        if self.raise_on is not None and len(self.calls) == self.raise_on:
+            raise RuntimeError("device exploded")
+        return not any(s.pubkey[0] == 0xBB for s in sets)
+
+
+def _bad(sets):
+    s = sets[0]
+    sets[0] = SignatureSet(pubkey=b"\xbb" + s.pubkey[1:], message=s.message, signature=s.signature)
+    return sets
+
+
+def test_chunkify():
+    assert chunkify_maximize_chunk_size([], 128) == []
+    assert chunkify_maximize_chunk_size(list(range(5)), 2) == [[0, 1], [2, 3], [4]]
+    out = chunkify_maximize_chunk_size(list(range(300)), MAX_SIGNATURE_SETS_PER_JOB)
+    assert [len(c) for c in out] == [100, 100, 100]
+
+
+def test_valid_batchable_sets_verify_together():
+    async def go():
+        be = Backend()
+        pool = BlsDeviceVerifierPool(be, buffer_wait_ms=5)
+        opts = VerifySignatureOpts(batchable=True)
+        r1, r2 = await asyncio.gather(
+            pool.verify_signature_sets(_sets(3, 1), opts),
+            pool.verify_signature_sets(_sets(4, 2), opts),
+        )
+        assert r1 and r2
+        # both jobs merged into ONE backend call of 7 sets
+        assert be.calls == [7]
+        assert pool.metrics["batch_sigs_success"] == 7
+        await pool.close()
+
+    asyncio.run(go())
+
+
+def test_invalid_batch_retries_individually():
+    async def go():
+        be = Backend()
+        pool = BlsDeviceVerifierPool(be, buffer_wait_ms=5)
+        opts = VerifySignatureOpts(batchable=True)
+        good = _sets(3, 1)
+        bad = _bad(_sets(2, 2))
+        r_good, r_bad = await asyncio.gather(
+            pool.verify_signature_sets(good, opts),
+            pool.verify_signature_sets(bad, opts),
+        )
+        # one poisoned set must NOT fail its batch neighbors
+        assert r_good is True
+        assert r_bad is False
+        # first call: merged batch (5); then per-job retries (3 and 2)
+        assert be.calls[0] == 5
+        assert sorted(be.calls[1:]) == [2, 3]
+        assert pool.metrics["batch_retries"] == 1
+        await pool.close()
+
+    asyncio.run(go())
+
+
+def test_buffer_flushes_on_sig_count():
+    async def go():
+        be = Backend()
+        # huge window: only the 32-sig threshold can flush
+        pool = BlsDeviceVerifierPool(be, buffer_wait_ms=60_000)
+        opts = VerifySignatureOpts(batchable=True)
+        ok = await asyncio.wait_for(pool.verify_signature_sets(_sets(33), opts), 5)
+        assert ok
+        await pool.close()
+
+    asyncio.run(go())
+
+
+def test_large_array_chunks_to_multiple_jobs():
+    async def go():
+        be = Backend()
+        pool = BlsDeviceVerifierPool(be)
+        ok = await pool.verify_signature_sets(_sets(300))
+        assert ok
+        # 300 sets -> 3 non-batchable jobs of 100
+        assert sorted(be.calls) == [100, 100, 100]
+        await pool.close()
+
+    asyncio.run(go())
+
+
+def test_device_error_fails_closed():
+    async def go():
+        be = Backend(raise_on=1)
+        pool = BlsDeviceVerifierPool(be)
+        with pytest.raises(RuntimeError, match="device exploded"):
+            await pool.verify_signature_sets(_sets(4))
+        await pool.close()
+
+    asyncio.run(go())
+
+
+def test_batchable_device_error_retries_then_fails_closed():
+    async def go():
+        # batch call raises; individual retries raise too -> reject, not True
+        class AlwaysRaise:
+            calls = 0
+
+            def __call__(self, sets):
+                type(self).calls += 1
+                raise RuntimeError("bad transport")
+
+        pool = BlsDeviceVerifierPool(AlwaysRaise(), buffer_wait_ms=5)
+        with pytest.raises(RuntimeError):
+            await pool.verify_signature_sets(_sets(2), VerifySignatureOpts(batchable=True))
+        assert pool.metrics["batch_retries"] == 1
+        await pool.close()
+
+    asyncio.run(go())
+
+
+def test_can_accept_work_bounds_queue():
+    async def go():
+        release = asyncio.Event()
+
+        def slow_backend(sets):
+            return True
+
+        pool = BlsDeviceVerifierPool(slow_backend)
+        assert pool.can_accept_work()
+        # simulate a full queue
+        pool._outstanding = MAX_JOBS_CAN_ACCEPT_WORK
+        assert not pool.can_accept_work()
+        pool._outstanding = 0
+        await pool.close()
+        assert not pool.can_accept_work()
+        release.set()
+
+    asyncio.run(go())
+
+
+def test_close_rejects_pending():
+    async def go():
+        pool = BlsDeviceVerifierPool(Backend(), buffer_wait_ms=60_000)
+        task = asyncio.ensure_future(
+            pool.verify_signature_sets(_sets(1), VerifySignatureOpts(batchable=True))
+        )
+        await asyncio.sleep(0.01)  # let it buffer
+        await pool.close()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+    asyncio.run(go())
+
+
+def test_single_thread_verifier_and_mock_share_seam():
+    async def go():
+        from lodestar_tpu.crypto.bls.api import SecretKey, sign
+
+        sk = SecretKey(7777)
+        msg = b"\x11" * 32
+        real = [SignatureSet(pubkey=sk.to_pubkey(), message=msg, signature=sign(sk, msg))]
+        st = BlsSingleThreadVerifier()
+        assert await st.verify_signature_sets(real)
+        mock = BlsVerifierMock(False)
+        assert not await mock.verify_signature_sets(real)
+        assert mock.calls == [1]
+        await st.close()
+        assert not st.can_accept_work()
+
+    asyncio.run(go())

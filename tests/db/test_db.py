@@ -1,0 +1,143 @@
+"""db layer: key encoding, controllers (memory + WAL file), repositories.
+
+Strategy mirrors the reference's `db` unit/e2e split: semantics against
+the memory controller, persistence/crash-replay against the file one.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from lodestar_tpu.db import (
+    Bucket,
+    FileDbController,
+    FilterOptions,
+    MemoryDbController,
+    Repository,
+    encode_key,
+)
+from lodestar_tpu.types import ssz_types
+
+
+def test_encode_key_orders_ints_numerically():
+    ks = [encode_key(Bucket.allForks_blockArchive, s) for s in (0, 1, 255, 256, 2**32)]
+    assert ks == sorted(ks)
+
+
+def test_encode_key_bucket_prefix_separates_namespaces():
+    a = encode_key(Bucket.allForks_block, b"\xff" * 32)
+    b = encode_key(Bucket.allForks_blockArchive, 0)
+    assert a[0] != b[0]
+
+
+def _fill(db):
+    for i in (3, 1, 2, 5, 4):
+        db.put(encode_key(Bucket.index_mainChain, i), bytes([i]))
+
+
+def test_memory_controller_range_filters():
+    db = MemoryDbController()
+    _fill(db)
+    k = lambda i: encode_key(Bucket.index_mainChain, i)
+    assert list(db.keys_stream(FilterOptions(gte=k(2), lt=k(5)))) == [k(2), k(3), k(4)]
+    assert list(db.keys_stream(FilterOptions(gt=k(2), lte=k(5)))) == [k(3), k(4), k(5)]
+    assert list(db.keys_stream(FilterOptions(reverse=True, limit=2))) == [k(5), k(4)]
+    db.delete(k(3))
+    assert [v for _, v in db.entries_stream(FilterOptions(gte=k(1), lt=k(5)))] == [
+        bytes([1]), bytes([2]), bytes([4])
+    ]
+
+
+def test_file_controller_persists_and_replays(tmp_path):
+    path = str(tmp_path / "db" / "wal.log")
+    db = FileDbController(path)
+    _fill(db)
+    db.delete(encode_key(Bucket.index_mainChain, 2))
+    db.put(encode_key(Bucket.index_mainChain, 1), b"\x99")
+    db.close()
+
+    db2 = FileDbController(path)
+    k = lambda i: encode_key(Bucket.index_mainChain, i)
+    assert db2.get(k(1)) == b"\x99"
+    assert db2.get(k(2)) is None
+    assert sorted(db2.keys_stream()) == [k(1), k(3), k(4), k(5)]
+    db2.close()
+
+
+def test_file_controller_discards_torn_tail(tmp_path):
+    path = str(tmp_path / "wal.log")
+    db = FileDbController(path)
+    db.put(b"\x01good", b"value")
+    db.close()
+    with open(path, "ab") as f:
+        f.write(b"\x00\xff\xff")  # torn partial record
+    db2 = FileDbController(path)
+    assert db2.get(b"\x01good") == b"value"
+    assert len(list(db2.keys_stream())) == 1
+    db2.close()
+
+
+def test_file_controller_compaction(tmp_path):
+    path = str(tmp_path / "wal.log")
+    db = FileDbController(path, compact_bytes=2_000)
+    for round_ in range(40):
+        for i in range(10):
+            db.put(encode_key(Bucket.index_mainChain, i), bytes([round_]) * 30)
+    size = os.path.getsize(path)
+    # 400 writes of ~43+ bytes would be >17k uncompacted
+    assert size < 4_000
+    db.close()
+    db2 = FileDbController(path)
+    assert db2.get(encode_key(Bucket.index_mainChain, 9)) == bytes([39]) * 30
+    db2.close()
+
+
+def test_repository_roundtrip_and_root_id():
+    t = ssz_types()
+    repo: Repository = Repository(MemoryDbController(), Bucket.allForks_block, t.phase0.SignedBeaconBlock)
+    block = t.phase0.SignedBeaconBlock.default()
+    block.message.slot = 7
+    repo.add(block)
+    root = t.phase0.SignedBeaconBlock.hash_tree_root(block)
+    assert repo.has(root)
+    got = repo.get(root)
+    assert got is not None and got.message.slot == 7
+    assert t.phase0.SignedBeaconBlock.hash_tree_root(got) == root
+    repo.remove(block)
+    assert not repo.has(root)
+
+
+def test_repository_slot_indexed_iteration():
+    t = ssz_types()
+    repo: Repository = Repository(
+        MemoryDbController(), Bucket.allForks_blockArchive, t.phase0.SignedBeaconBlock
+    )
+    for slot in (30, 10, 20):
+        b = t.phase0.SignedBeaconBlock.default()
+        b.message.slot = slot
+        repo.put(slot, b)
+    assert [b.message.slot for b in repo.values()] == [10, 20, 30]
+    assert [b.message.slot for b in repo.values(gte=15, lt=30)] == [20]
+    assert repo.last_value().message.slot == 30
+    assert repo.first_value().message.slot == 10
+
+
+def test_repository_batch_ops_and_bucket_isolation():
+    t = ssz_types()
+    db = MemoryDbController()
+    blocks: Repository = Repository(db, Bucket.allForks_block, t.phase0.SignedBeaconBlock)
+    exits: Repository = Repository(db, Bucket.phase0_exit, t.SignedVoluntaryExit)
+    vals = []
+    for i in range(3):
+        b = t.phase0.SignedBeaconBlock.default()
+        b.message.proposer_index = i
+        vals.append(b)
+    blocks.batch_add(vals)
+    e = t.SignedVoluntaryExit.default()
+    exits.put(5, e)
+    assert len(blocks.values()) == 3
+    assert len(exits.values()) == 1  # no cross-bucket bleed
+    blocks.batch_delete([blocks.get_id(v) for v in vals])
+    assert blocks.values() == []

@@ -1,0 +1,305 @@
+"""Proto-array + vectorized compute_deltas + ForkChoice store tests.
+
+Scenario strategy mirrors the reference's unit suites
+(`fork-choice/test/unit/protoArray/*.test.ts`): linear chains, competing
+forks flipped by votes, FFG viability filtering, pruning index fixups,
+equivocation discounting, proposer boost, balance changes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.fork_choice import (
+    Checkpoint,
+    ExecutionStatus,
+    ForkChoice,
+    HEX_ZERO_HASH,
+    ProtoArray,
+    ProtoArrayError,
+    ProtoBlock,
+    VoteTracker,
+    compute_deltas,
+)
+
+SPE = 8  # slots per epoch for these tests
+
+
+def _root(i: int) -> str:
+    # offset by 1: the all-zero root is the genesis alias, never a real block
+    return "0x" + (i + 1).to_bytes(32, "big").hex()
+
+
+def _block(i: int, parent: int | None, slot: int | None = None, je: int = 0, fe: int = 0) -> ProtoBlock:
+    return ProtoBlock(
+        slot=slot if slot is not None else i,
+        block_root=_root(i),
+        parent_root=_root(parent) if parent is not None else _root(10**9),
+        state_root=_root(i),
+        target_root=_root(i),
+        justified_epoch=je,
+        justified_root=_root(0),
+        finalized_epoch=fe,
+        finalized_root=_root(0),
+        unrealized_justified_epoch=je,
+        unrealized_finalized_epoch=fe,
+    )
+
+
+def _new_array(genesis: int = 0) -> ProtoArray:
+    return ProtoArray.initialize(_block(genesis, None, slot=0), current_slot=0, slots_per_epoch=SPE)
+
+
+def test_linear_chain_head_is_tip():
+    arr = _new_array()
+    for i in range(1, 5):
+        arr.on_block(_block(i, i - 1), current_slot=i)
+    arr.apply_score_changes(
+        deltas=[0] * 5, proposer_boost=None, justified_epoch=0, justified_root=_root(0),
+        finalized_epoch=0, finalized_root=_root(0), current_slot=5,
+    )
+    assert arr.find_head(_root(0), current_slot=5) == _root(4)
+
+
+def test_votes_flip_between_forks():
+    # 0 <- 1 <- 2 (fork a)
+    #        <- 3 (fork b)
+    arr = _new_array()
+    arr.on_block(_block(1, 0), 1)
+    arr.on_block(_block(2, 1), 2)
+    arr.on_block(_block(3, 1, slot=2), 2)
+
+    def score(d2, d3):
+        deltas = [0] * len(arr.indices)
+        deltas[arr.indices[_root(2)]] = d2
+        deltas[arr.indices[_root(3)]] = d3
+        arr.apply_score_changes(
+            deltas=deltas, proposer_boost=None, justified_epoch=0, justified_root=_root(0),
+            finalized_epoch=0, finalized_root=_root(0), current_slot=3,
+        )
+
+    score(10, 5)
+    assert arr.find_head(_root(0), 3) == _root(2)
+    score(0, 10)  # fork b overtakes: 10 vs 15
+    assert arr.find_head(_root(0), 3) == _root(3)
+
+
+def test_tie_breaks_by_root_ordering():
+    arr = _new_array()
+    arr.on_block(_block(1, 0), 1)
+    arr.on_block(_block(2, 0, slot=1), 1)
+    arr.apply_score_changes(
+        deltas=[0, 0, 0], proposer_boost=None, justified_epoch=0, justified_root=_root(0),
+        finalized_epoch=0, finalized_root=_root(0), current_slot=2,
+    )
+    # equal weight: higher root wins (reference protoArray.ts:668)
+    assert arr.find_head(_root(0), 2) == _root(2)
+
+
+def test_ffg_viability_filters_wrong_justified_epoch():
+    # store justified at epoch 1: a current-epoch block whose state is
+    # still at justified epoch 0 (and unrealized 0) is not viable even
+    # with the larger weight (filter_block_tree semantics)
+    arr = _new_array()
+    viable = _block(1, 0, slot=2 * SPE + 1, je=1)
+    arr.on_block(viable, 2 * SPE + 1)
+    stale = _block(2, 0, slot=2 * SPE + 1, je=0)
+    stale.unrealized_justified_epoch = 0
+    arr.on_block(stale, 2 * SPE + 1)
+    arr.apply_score_changes(
+        deltas=[0, 1, 100], proposer_boost=None, justified_epoch=1, justified_root=_root(0),
+        finalized_epoch=0, finalized_root=_root(0), current_slot=2 * SPE + 2,
+    )
+    assert arr.find_head(_root(0), 2 * SPE + 2) == _root(1)
+
+
+def test_invalid_execution_zeroes_weight_and_filters():
+    arr = _new_array()
+    b1 = _block(1, 0)
+    b1.execution_status = ExecutionStatus.SYNCING
+    b1.execution_payload_block_hash = "0xee"
+    arr.on_block(b1, 1)
+    b2 = _block(2, 0, slot=1)
+    arr.on_block(b2, 1)
+    arr.apply_score_changes(
+        deltas=[0, 100, 1], proposer_boost=None, justified_epoch=0, justified_root=_root(0),
+        finalized_epoch=0, finalized_root=_root(0), current_slot=2,
+    )
+    assert arr.find_head(_root(0), 2) == _root(1)
+    arr.invalidate(_root(1), 2)
+    arr.apply_score_changes(
+        deltas=[0, 0, 0], proposer_boost=None, justified_epoch=0, justified_root=_root(0),
+        finalized_epoch=0, finalized_root=_root(0), current_slot=2,
+    )
+    node = arr.get_block(_root(1))
+    assert node is not None and node.weight == 0
+    assert arr.find_head(_root(0), 2) == _root(2)
+
+
+def test_prune_reindexes():
+    arr = _new_array()
+    for i in range(1, 6):
+        arr.on_block(_block(i, i - 1), i)
+    removed = arr.maybe_prune(_root(3))
+    assert [n.block_root for n in removed] == [_root(0), _root(1), _root(2)]
+    assert arr.indices[_root(3)] == 0
+    arr.apply_score_changes(
+        deltas=[0, 0, 0], proposer_boost=None, justified_epoch=0, justified_root=_root(3),
+        finalized_epoch=0, finalized_root=_root(0), current_slot=6,
+    )
+    assert arr.find_head(_root(3), 6) == _root(5)
+    # parent links below finalization cleared
+    assert arr.get_block(_root(3)).parent is None
+
+
+def test_on_block_rejects_invalid_execution():
+    arr = _new_array()
+    bad = _block(1, 0)
+    bad.execution_status = ExecutionStatus.INVALID
+    with pytest.raises(ProtoArrayError):
+        arr.on_block(bad, 1)
+
+
+# -- compute_deltas -----------------------------------------------------------
+
+
+def _fc_pair():
+    arr = _new_array()
+    arr.on_block(_block(1, 0), 1)
+    arr.on_block(_block(2, 0, slot=1), 1)
+    return arr
+
+
+def test_compute_deltas_applies_new_votes():
+    arr = _fc_pair()
+    votes = VoteTracker()
+    for vi in range(4):
+        votes.process_attestation(vi, _root(1), 1)
+    for vi in range(4, 10):
+        votes.process_attestation(vi, _root(2), 1)
+    bal = np.full(10, 7, dtype=np.int64)
+    deltas = compute_deltas(arr.indices, votes, bal, bal)
+    assert deltas[arr.indices[_root(1)]] == 4 * 7
+    assert deltas[arr.indices[_root(2)]] == 6 * 7
+    # second call: no changes -> all zero
+    deltas2 = compute_deltas(arr.indices, votes, bal, bal)
+    assert all(d == 0 for d in deltas2)
+
+
+def test_compute_deltas_vote_moves():
+    arr = _fc_pair()
+    votes = VoteTracker()
+    votes.process_attestation(0, _root(1), 1)
+    bal = np.array([5], dtype=np.int64)
+    compute_deltas(arr.indices, votes, bal, bal)
+    votes.process_attestation(0, _root(2), 2)
+    deltas = compute_deltas(arr.indices, votes, bal, bal)
+    assert deltas[arr.indices[_root(1)]] == -5
+    assert deltas[arr.indices[_root(2)]] == 5
+
+
+def test_compute_deltas_balance_change():
+    arr = _fc_pair()
+    votes = VoteTracker()
+    votes.process_attestation(0, _root(1), 1)
+    old = np.array([5], dtype=np.int64)
+    compute_deltas(arr.indices, votes, old, old)
+    new = np.array([9], dtype=np.int64)
+    deltas = compute_deltas(arr.indices, votes, old, new)
+    assert deltas[arr.indices[_root(1)]] == 4  # -5 +9 on same node
+
+
+def test_compute_deltas_equivocation_discounts_once():
+    arr = _fc_pair()
+    votes = VoteTracker()
+    votes.process_attestation(0, _root(1), 1)
+    bal = np.array([5], dtype=np.int64)
+    compute_deltas(arr.indices, votes, bal, bal)
+    votes.mark_equivocation(0)
+    deltas = compute_deltas(arr.indices, votes, bal, bal)
+    assert deltas[arr.indices[_root(1)]] == -5
+    # only once
+    deltas2 = compute_deltas(arr.indices, votes, bal, bal)
+    assert all(d == 0 for d in deltas2)
+    # new attestations from the equivocator are ignored
+    votes.process_attestation(0, _root(2), 3)
+    deltas3 = compute_deltas(arr.indices, votes, bal, bal)
+    assert all(d == 0 for d in deltas3)
+
+
+def test_compute_deltas_old_vote_ignored():
+    arr = _fc_pair()
+    votes = VoteTracker()
+    votes.process_attestation(0, _root(2), 5)
+    votes.process_attestation(0, _root(1), 4)  # older target epoch: ignored
+    bal = np.array([3], dtype=np.int64)
+    deltas = compute_deltas(arr.indices, votes, bal, bal)
+    assert deltas[arr.indices[_root(2)]] == 3
+    assert deltas[arr.indices[_root(1)]] == 0
+
+
+# -- ForkChoice wrapper -------------------------------------------------------
+
+
+def _forkchoice(n_validators: int = 10, balance: int = 32) -> ForkChoice:
+    anchor = _block(0, None, slot=0)
+    return ForkChoice.from_anchor(
+        anchor,
+        current_slot=1,
+        justified_balances=np.full(n_validators, balance, dtype=np.int64),
+        slots_per_epoch=SPE,
+    )
+
+
+def test_forkchoice_votes_drive_head():
+    fc = _forkchoice()
+    fc.on_block(_block(1, 0))
+    fc.on_block(_block(2, 0, slot=1))
+    fc.on_attestation([0, 1, 2], _root(1), 1, slot=0)
+    fc.on_attestation([3, 4, 5, 6], _root(2), 1, slot=0)
+    assert fc.update_head() == _root(2)
+    # supermajority flips to fork 1
+    fc.on_attestation([3, 4, 5, 6, 7, 8, 9], _root(1), 2, slot=0)
+    assert fc.update_head() == _root(1)
+
+
+def test_forkchoice_future_attestations_queue_until_tick():
+    fc = _forkchoice()
+    fc.on_block(_block(1, 0))
+    fc.on_block(_block(2, 0, slot=1))
+    fc.on_attestation([0], _root(1), 1, slot=0)
+    fc.on_attestation([1, 2, 3], _root(2), 1, slot=5)  # future slot: queued
+    assert fc.update_head() == _root(1)
+    fc.on_tick(6)
+    assert fc.update_head() == _root(2)
+
+
+def test_forkchoice_proposer_boost():
+    # committee weight = 80*32/8 = 320; boost = 128 > one attester's 32
+    fc = _forkchoice(n_validators=80, balance=32)
+    fc.on_block(_block(1, 0))
+    fc.on_attestation([0], _root(1), 1, slot=0)
+    assert fc.update_head() == _root(1)
+    # timely block on a competing fork gets boosted above one attester
+    fc.on_tick(2)
+    b2 = _block(2, 0, slot=2)
+    fc.on_block(b2, is_timely=True)
+    assert fc.update_head() == _root(2)
+    # boost expires at the next slot; the vote still stands
+    fc.on_tick(3)
+    assert fc.update_head() == _root(1)
+
+
+def test_forkchoice_finalization_prunes():
+    fc = _forkchoice()
+    # realistic slots: the finalized block sits at the epoch-1 boundary
+    # (slot 8) and its descendants come after it
+    for i, slot in [(1, 4), (2, SPE), (3, SPE + 4), (4, 2 * SPE)]:
+        fc.current_slot = max(fc.current_slot, slot + 1)
+        fc.on_block(_block(i, i - 1, slot=slot))
+    fc.finalized = Checkpoint(1, _root(2))
+    removed = fc.prune()
+    assert [n.block_root for n in removed] == [_root(0), _root(1)]
+    fc.justified = Checkpoint(0, _root(2))
+    assert fc.update_head() == _root(4)
