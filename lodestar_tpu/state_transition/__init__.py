@@ -1,0 +1,138 @@
+"""Consensus state transition (reference `packages/state-transition/src`).
+
+`state_transition(state, signed_block)` = process_slots to the block's
+slot (epoch processing at boundaries) + process_block + state-root check
+— the reference's flow at `stateTransition.ts:42,120`. States are typed
+SSZ ContainerValues; per-validator hot loops run vectorized in numpy
+(see `epoch.py`); hash_tree_root rides the batched SHA-256 device path
+through `ssz` for large states.
+"""
+
+from __future__ import annotations
+
+from lodestar_tpu.params import BeaconPreset, active_preset
+from lodestar_tpu.types import ssz_types
+
+from .block import (  # noqa: F401
+    BlockProcessError,
+    get_indexed_attestation,
+    is_valid_indexed_attestation,
+    process_attestation,
+    process_attester_slashing,
+    process_block,
+    process_block_header,
+    process_deposit,
+    process_eth1_data,
+    process_operations,
+    process_proposer_slashing,
+    process_randao,
+    process_voluntary_exit,
+    slash_validator,
+)
+from .cache import EpochContext, EpochShuffling  # noqa: F401
+from .epoch import (  # noqa: F401
+    EpochProcess,
+    before_process_epoch,
+    get_attestation_deltas,
+    process_epoch,
+)
+from .shuffle import compute_proposer_index, compute_shuffled_index, unshuffle_list  # noqa: F401
+from .util import (  # noqa: F401
+    compute_epoch_at_slot,
+    compute_signing_root,
+    compute_start_slot_at_epoch,
+    get_current_epoch,
+    get_domain,
+    get_previous_epoch,
+    get_total_active_balance,
+)
+
+__all__ = [
+    "state_transition",
+    "process_slots",
+    "process_slot",
+    "process_block",
+    "process_epoch",
+    "EpochContext",
+    "EpochProcess",
+    "BlockProcessError",
+    "StateTransitionError",
+]
+
+
+class StateTransitionError(Exception):
+    pass
+
+
+def _state_type(state, p: BeaconPreset):
+    # the registry's container name encodes the fork (BeaconStatePhase0...)
+    return state.type
+
+
+def process_slot(state, p: BeaconPreset | None = None) -> None:
+    """Spec process_slot: cache state root, backfill latest header state
+    root, cache block root."""
+    p = p or active_preset()
+    t = ssz_types(p)
+    prev_state_root = _state_type(state, p).hash_tree_root(state)
+    state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
+    if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
+        state.latest_block_header.state_root = prev_state_root
+    prev_block_root = t.BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+    state.block_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_block_root
+
+
+def process_slots(state, slot: int, p: BeaconPreset | None = None, cfg=None) -> EpochContext:
+    """Advance state to `slot`, running epoch processing at boundaries.
+    Returns the EpochContext valid for the final slot's epoch."""
+    p = p or active_preset()
+    if slot <= state.slot:
+        raise StateTransitionError(f"cannot advance to past slot {slot} <= {state.slot}")
+    ctx: EpochContext | None = None
+    while state.slot < slot:
+        process_slot(state, p)
+        if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
+            process_epoch(state, EpochContext(state, p), cfg)
+            ctx = None  # shufflings/proposers change across the boundary
+        state.slot += 1
+    return ctx or EpochContext(state, p)
+
+
+def state_transition(
+    state,
+    signed_block,
+    p: BeaconPreset | None = None,
+    cfg=None,
+    *,
+    verify_state_root: bool = True,
+    verify_proposer_signature: bool = True,
+    verify_signatures: bool = True,
+):
+    """Full STF: returns the post-state (input state is copied first —
+    callers keep the pre-state, reference stateTransition.ts:59 clone).
+    """
+    p = p or active_preset()
+    block = signed_block.message
+    post = state.copy()
+    ctx = process_slots(post, block.slot, p, cfg)
+
+    if verify_proposer_signature:
+        from lodestar_tpu.crypto.bls import api as bls
+        from lodestar_tpu.params import DOMAIN_BEACON_PROPOSER
+
+        t = ssz_types(p)
+        proposer = post.validators[block.proposer_index]
+        domain = get_domain(post, DOMAIN_BEACON_PROPOSER)
+        root = compute_signing_root(t.phase0.BeaconBlock, block, domain)
+        if not bls.verify(bytes(proposer.pubkey), root, bytes(signed_block.signature)):
+            raise StateTransitionError("invalid block proposer signature")
+
+    process_block(post, block, ctx, verify_signatures, cfg)
+
+    if verify_state_root:
+        got = _state_type(post, p).hash_tree_root(post)
+        if got != bytes(block.state_root):
+            raise StateTransitionError(
+                f"state root mismatch: block {bytes(block.state_root).hex()[:16]} != computed {got.hex()[:16]}"
+            )
+    return post

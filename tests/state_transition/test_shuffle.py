@@ -1,0 +1,47 @@
+"""Shuffle: vectorized list permutation vs the scalar spec function."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lodestar_tpu.params import active_preset
+from lodestar_tpu.state_transition.shuffle import (
+    compute_proposer_index,
+    compute_shuffled_index,
+    unshuffle_list,
+)
+
+
+def test_unshuffle_matches_scalar_spec_fn():
+    p = active_preset()
+    seed = b"\x07" * 32
+    for n in (1, 2, 7, 33, 257):
+        indices = np.arange(n, dtype=np.int64) + 100
+        out = unshuffle_list(indices, seed, p)
+        expect = np.array(
+            [indices[compute_shuffled_index(i, n, seed, p)] for i in range(n)]
+        )
+        assert np.array_equal(out, expect), f"n={n}"
+
+
+def test_unshuffle_is_permutation_and_seed_sensitive():
+    p = active_preset()
+    indices = np.arange(100, dtype=np.int64)
+    a = unshuffle_list(indices, b"\x01" * 32, p)
+    b = unshuffle_list(indices, b"\x02" * 32, p)
+    assert sorted(a.tolist()) == list(range(100))
+    assert sorted(b.tolist()) == list(range(100))
+    assert a.tolist() != b.tolist()
+
+
+def test_proposer_selection_weighted_by_effective_balance():
+    p = active_preset()
+    n = 64
+    indices = np.arange(n, dtype=np.int64)
+    eb = np.full(n, p.MAX_EFFECTIVE_BALANCE, dtype=np.int64)
+    # zero-balance validators are (almost) never chosen
+    eb[: n // 2] = 0
+    chosen = {
+        compute_proposer_index(eb, indices, bytes([s]) * 32, p) for s in range(40)
+    }
+    assert all(c >= n // 2 for c in chosen)
