@@ -1,0 +1,354 @@
+"""BeaconChain orchestrator + block import pipeline.
+
+Reference `beacon-node/src/chain/chain.ts:88` + `chain/blocks/`:
+
+* sanity checks (known root, finalized horizon, known parent) —
+  `verifyBlocksSanityChecks.ts`
+* verify: pre-state via the state cache/regen, then the reference's
+  parallel split (`verifyBlock.ts:89-111`): signature-free STF and the
+  batched signature verification run CONCURRENTLY — the STF on the host
+  event loop, the signature sets through the async device verifier pool
+  (`asyncio.gather` is the asyncio translation of the Promise.all).
+* import: fork-choice onBlock + operation attestations into fork choice
+  + head update + hot-db persist + state cache (`importBlock.ts:51`).
+* regen: replay blocks from the nearest cached/stored state
+  (`chain/regen/regen.ts` without the queue; the job queue lives in
+  the caller).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from lodestar_tpu.db import Bucket, DbController, Repository
+from lodestar_tpu.fork_choice import Checkpoint, ForkChoice, ProtoBlock
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.params import BeaconPreset, active_preset
+from lodestar_tpu.state_transition import (
+    EpochContext,
+    compute_epoch_at_slot,
+    process_block,
+    process_slots,
+)
+from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
+from lodestar_tpu.state_transition.util import effective_balances_array
+from lodestar_tpu.types import ssz_types
+
+from .bls import IBlsVerifier, VerifySignatureOpts
+from .op_pools import AggregatedAttestationPool, AttestationPool, OpPool, SeenAttesters
+
+__all__ = ["BeaconChain", "BlockError", "BlockErrorCode"]
+
+
+class BlockErrorCode:
+    ALREADY_KNOWN = "ALREADY_KNOWN"
+    PARENT_UNKNOWN = "PARENT_UNKNOWN"
+    WOULD_REVERT_FINALIZED = "WOULD_REVERT_FINALIZED"
+    PRESTATE_MISSING = "PRESTATE_MISSING"
+    INVALID_SIGNATURES = "INVALID_SIGNATURES"
+    INVALID_STATE_TRANSITION = "INVALID_STATE_TRANSITION"
+    FUTURE_SLOT = "FUTURE_SLOT"
+
+
+class BlockError(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(f"{code}: {message}" if message else code)
+        self.code = code
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+class StateCache:
+    """LRU hot-state cache by block root (reference
+    `stateCache/stateContextCache.ts`, max 96)."""
+
+    def __init__(self, max_states: int = 96):
+        self.max_states = max_states
+        self._by_root: dict[bytes, object] = {}
+
+    def get(self, block_root: bytes):
+        st = self._by_root.get(block_root)
+        if st is not None:
+            # refresh LRU position
+            self._by_root.pop(block_root)
+            self._by_root[block_root] = st
+        return st
+
+    def add(self, block_root: bytes, state) -> None:
+        self._by_root[block_root] = state
+        while len(self._by_root) > self.max_states:
+            self._by_root.pop(next(iter(self._by_root)))
+
+    def prune_except(self, keep_roots: set[bytes]) -> None:
+        for root in [r for r in self._by_root if r not in keep_roots]:
+            del self._by_root[root]
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        *,
+        anchor_state,
+        bls_verifier: IBlsVerifier,
+        db: DbController,
+        p: BeaconPreset | None = None,
+        cfg=None,
+        genesis_block_root: bytes | None = None,
+        current_slot: int | None = None,
+        metrics=None,
+    ) -> None:
+        self.p = p = p or active_preset()
+        self.cfg = cfg
+        self.bls = bls_verifier
+        self.metrics = metrics
+        self.log = get_logger(name="lodestar.chain")
+        t = ssz_types(p)
+        self.types = t
+
+        self.blocks_db: Repository = Repository(db, Bucket.allForks_block, t.phase0.SignedBeaconBlock)
+        self.states_db: Repository = Repository(db, Bucket.allForks_stateArchive, anchor_state.type)
+
+        self.state_cache = StateCache()
+        self.attestation_pool = AttestationPool()
+        self.aggregated_attestation_pool = AggregatedAttestationPool()
+        self.op_pool = OpPool()
+        self.seen_attesters = SeenAttesters()
+
+        # anchor: latest block header of the anchor state defines the root
+        header = anchor_state.latest_block_header.copy()
+        if bytes(header.state_root) == b"\x00" * 32:
+            header.state_root = anchor_state.type.hash_tree_root(anchor_state)
+        anchor_root = genesis_block_root or t.BeaconBlockHeader.hash_tree_root(header)
+        self.state_cache.add(anchor_root, anchor_state)
+
+        anchor_epoch = compute_epoch_at_slot(anchor_state.slot, p)
+        anchor_cp = Checkpoint(anchor_epoch, _hex(anchor_root))
+        proto = ProtoBlock(
+            slot=anchor_state.slot,
+            block_root=_hex(anchor_root),
+            parent_root=_hex(b"\xff" * 32),
+            state_root=_hex(bytes(header.state_root)),
+            target_root=_hex(anchor_root),
+            justified_epoch=anchor_cp.epoch,
+            justified_root=anchor_cp.root,
+            finalized_epoch=anchor_cp.epoch,
+            finalized_root=anchor_cp.root,
+            unrealized_justified_epoch=anchor_cp.epoch,
+            unrealized_finalized_epoch=anchor_cp.epoch,
+        )
+        self.fork_choice = ForkChoice.from_anchor(
+            proto,
+            current_slot=current_slot if current_slot is not None else anchor_state.slot,
+            justified_balances=effective_balances_array(anchor_state),
+            slots_per_epoch=p.SLOTS_PER_EPOCH,
+        )
+        self._subscribers: dict[str, list[Callable]] = {"block": [], "head": [], "finalized": []}
+
+    # -- events ---------------------------------------------------------------
+
+    def on(self, event: str, fn: Callable) -> None:
+        self._subscribers[event].append(fn)
+
+    def _emit(self, event: str, *args) -> None:
+        for fn in self._subscribers.get(event, ()):
+            fn(*args)
+
+    # -- clock ----------------------------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        self.fork_choice.on_tick(slot)
+        self.attestation_pool.prune(slot)
+        self.aggregated_attestation_pool.prune(slot)
+
+    # -- regen ----------------------------------------------------------------
+
+    def get_state_by_block_root(self, block_root: bytes):
+        """Hot-cache hit or replay from the nearest stored ancestor state
+        (reference `regen/regen.ts` getState)."""
+        st = self.state_cache.get(block_root)
+        if st is not None:
+            return st
+        # walk ancestors in fork choice until a cached state is found
+        chain: list[bytes] = []
+        root = block_root
+        while True:
+            chain.append(root)
+            node = self.fork_choice.proto_array.get_block(_hex(root))
+            if node is None:
+                raise BlockError(BlockErrorCode.PRESTATE_MISSING, _hex(root))
+            parent = bytes.fromhex(node.parent_root[2:])
+            st = self.state_cache.get(parent)
+            if st is not None:
+                break
+            root = parent
+        # replay forward
+        for r in reversed(chain):
+            signed = self.blocks_db.get(r)
+            if signed is None:
+                raise BlockError(BlockErrorCode.PRESTATE_MISSING, f"block {_hex(r)} not in db")
+            st = self._replay_block(st, signed)
+            self.state_cache.add(r, st)
+        return st
+
+    def _replay_block(self, pre_state, signed_block):
+        post = pre_state.copy()
+        block = signed_block.message
+        if block.slot > post.slot:
+            ctx = process_slots(post, block.slot, self.p, self.cfg)
+        else:
+            ctx = EpochContext(post, self.p)
+        process_block(post, block, ctx, verify_signatures=False, cfg=self.cfg)
+        return post
+
+    # -- block import ---------------------------------------------------------
+
+    async def process_block(self, signed_block, *, is_timely: bool = False):
+        """Full import pipeline for one gossip/sync block."""
+        t = self.types
+        block = signed_block.message
+        block_root = t.phase0.BeaconBlock.hash_tree_root(block)
+
+        # 1. sanity (verifyBlocksSanityChecks.ts)
+        if self.fork_choice.proto_array.has_block(_hex(block_root)):
+            raise BlockError(BlockErrorCode.ALREADY_KNOWN, _hex(block_root))
+        finalized_slot = self.fork_choice.finalized.epoch * self.p.SLOTS_PER_EPOCH
+        if block.slot <= finalized_slot:
+            raise BlockError(
+                BlockErrorCode.WOULD_REVERT_FINALIZED, f"slot {block.slot} <= {finalized_slot}"
+            )
+        if block.slot > self.fork_choice.current_slot:
+            raise BlockError(BlockErrorCode.FUTURE_SLOT, f"slot {block.slot}")
+        parent_root = bytes(block.parent_root)
+        parent = self.fork_choice.proto_array.get_block(_hex(parent_root))
+        if parent is None:
+            raise BlockError(BlockErrorCode.PARENT_UNKNOWN, _hex(parent_root))
+
+        # 2. pre-state + dial to block slot
+        pre_state = self.get_state_by_block_root(parent_root)
+        work_state = pre_state.copy()
+        if block.slot > work_state.slot:
+            ctx = process_slots(work_state, block.slot, self.p, self.cfg)
+        else:
+            ctx = EpochContext(work_state, self.p)
+
+        # 3. parallel: signature-free STF on this task + batched signature
+        # verification through the device pool (verifyBlock.ts:89-111)
+        import asyncio
+
+        sets = get_block_signature_sets(work_state, signed_block, ctx)
+        sig_task = asyncio.ensure_future(
+            self.bls.verify_signature_sets(sets, VerifySignatureOpts(batchable=False))
+        )
+
+        def run_stf():
+            from lodestar_tpu.state_transition import BlockProcessError, StateTransitionError
+
+            post = work_state  # already copied + dialed
+            try:
+                process_block(post, block, ctx, verify_signatures=False, cfg=self.cfg)
+            except (BlockProcessError, StateTransitionError) as e:
+                raise BlockError(BlockErrorCode.INVALID_STATE_TRANSITION, str(e)) from e
+            got = post.type.hash_tree_root(post)
+            if got != bytes(block.state_root):
+                raise BlockError(BlockErrorCode.INVALID_STATE_TRANSITION, "state root mismatch")
+            return post
+
+        stf_task = asyncio.get_event_loop().run_in_executor(None, run_stf)
+        results = await asyncio.gather(stf_task, sig_task, return_exceptions=True)
+        stf_res, sig_res = results
+        if isinstance(stf_res, BaseException):
+            if not sig_task.done():
+                sig_task.cancel()
+            raise stf_res
+        if isinstance(sig_res, BaseException):
+            # fail closed: a verifier/transport error rejects the block
+            # import, it never resolves valid (multithread/index.ts:386-393)
+            raise BlockError(BlockErrorCode.INVALID_SIGNATURES, f"verifier error: {sig_res!r}")
+        post_state, sigs_ok = stf_res, sig_res
+        if not sigs_ok:
+            raise BlockError(BlockErrorCode.INVALID_SIGNATURES, _hex(block_root))
+
+        # 4. import (importBlock.ts:51)
+        self.blocks_db.put(block_root, signed_block)
+        self.state_cache.add(block_root, post_state)
+
+        blk_epoch = compute_epoch_at_slot(block.slot, self.p)
+        jc = post_state.current_justified_checkpoint
+        fc_cp = post_state.finalized_checkpoint
+        proto = ProtoBlock(
+            slot=block.slot,
+            block_root=_hex(block_root),
+            parent_root=_hex(parent_root),
+            state_root=_hex(bytes(block.state_root)),
+            target_root=_hex(self._target_root(post_state, blk_epoch, block_root)),
+            justified_epoch=jc.epoch,
+            justified_root=_hex(bytes(jc.root)),
+            finalized_epoch=fc_cp.epoch,
+            finalized_root=_hex(bytes(fc_cp.root)),
+            unrealized_justified_epoch=jc.epoch,
+            unrealized_finalized_epoch=fc_cp.epoch,
+        )
+        prev_finalized = self.fork_choice.finalized.epoch
+        self.fork_choice.on_block(
+            proto,
+            is_timely=is_timely,
+            justified_checkpoint=Checkpoint(jc.epoch, _hex(bytes(jc.root))),
+            finalized_checkpoint=Checkpoint(fc_cp.epoch, _hex(bytes(fc_cp.root))),
+            justified_balances=effective_balances_array(post_state),
+        )
+
+        # operation attestations feed LMD votes (importBlock.ts:130)
+        for att in block.body.attestations:
+            try:
+                attesting = ctx.get_attesting_indices(att.data, att.aggregation_bits)
+            except ValueError:
+                continue
+            self.fork_choice.on_attestation(
+                [int(i) for i in attesting],
+                _hex(bytes(att.data.beacon_block_root)),
+                att.data.target.epoch,
+                att.data.slot,
+            )
+
+        head = self.fork_choice.update_head()
+        self._emit("block", block_root, signed_block)
+        self._emit("head", head)
+        if self.metrics is not None:
+            self.metrics.head_slot.set(block.slot)
+            self.metrics.finalized_epoch.set(fc_cp.epoch)
+            self.metrics.justified_epoch.set(jc.epoch)
+
+        if fc_cp.epoch > prev_finalized:
+            self._on_finalized(fc_cp)
+        return block_root
+
+    def _target_root(self, state, epoch: int, block_root: bytes) -> bytes:
+        from lodestar_tpu.state_transition.util import get_block_root
+
+        try:
+            return get_block_root(state, epoch, self.p)
+        except ValueError:
+            return block_root
+
+    def _on_finalized(self, cp) -> None:
+        """Archive + prune on finalization (reference `archiver/`)."""
+        root = bytes(cp.root)
+        self.fork_choice.prune()
+        keep = {bytes.fromhex(n.block_root[2:]) for n in self.fork_choice.proto_array.nodes}
+        self.state_cache.prune_except(keep)
+        st = self.state_cache.get(root)
+        if st is not None:
+            self.states_db.put(root, st)
+            self.op_pool.prune_all(st)
+        self._emit("finalized", cp)
+
+    # -- head accessors -------------------------------------------------------
+
+    @property
+    def head_root(self) -> bytes:
+        return bytes.fromhex(self.fork_choice.head[2:])
+
+    def get_head_state(self):
+        return self.get_state_by_block_root(self.head_root)

@@ -1,0 +1,225 @@
+"""Gossip validation: the spec accept/ignore/reject checks per topic.
+
+Reference `beacon-node/src/chain/validation/` — `validateGossipAttestation`
+(`attestation.ts`), `validateGossipAggregateAndProof`
+(`aggregateAndProof.ts`), `validateGossipBlock` (`block.ts`). The BLS
+checks yield `SignatureSet`s for the batched verifier rather than
+verifying inline (the `batchable: true` path of the hot loop,
+`attestation.ts:271`).
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.params import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_SELECTION_PROOF,
+)
+from lodestar_tpu.state_transition import EpochContext, compute_epoch_at_slot
+from lodestar_tpu.state_transition.signature_sets import indexed_attestation_signature_set
+from lodestar_tpu.state_transition.util import compute_signing_root, get_domain
+from lodestar_tpu.types import ssz_types
+
+__all__ = [
+    "GossipAction",
+    "GossipValidationError",
+    "validate_gossip_attestation",
+    "validate_gossip_aggregate_and_proof",
+    "validate_gossip_block",
+    "is_aggregator",
+]
+
+
+class GossipAction(enum.Enum):
+    IGNORE = "IGNORE"
+    REJECT = "REJECT"
+
+
+class GossipValidationError(Exception):
+    def __init__(self, action: GossipAction, reason: str):
+        super().__init__(f"{action.value}: {reason}")
+        self.action = action
+        self.reason = reason
+
+
+def _state_dialed_to(chain, block_root: bytes, slot: int):
+    """State of `block_root` advanced (copy-on-advance) so its epoch
+    covers `slot` — epoch-boundary attestations need next-epoch
+    shufflings the block's own post-state doesn't have (the reference
+    regen dials to the target epoch, `attestation.ts:394-400`)."""
+    from lodestar_tpu.state_transition import compute_epoch_at_slot as epoch_at
+    from lodestar_tpu.state_transition import process_slots
+
+    state = chain.get_state_by_block_root(block_root)
+    if epoch_at(slot, chain.p) > epoch_at(state.slot, chain.p):
+        state = state.copy()
+        process_slots(state, slot, chain.p, chain.cfg)
+    return state
+
+
+@dataclass
+class AttestationValidationResult:
+    indexed_attestation: object
+    attesting_indices: list[int]
+    signature_sets: list[SignatureSet]
+
+
+def validate_gossip_attestation(
+    chain, attestation, subnet_id: int | None = None
+) -> AttestationValidationResult:
+    """Spec beacon_attestation topic checks (reference `attestation.ts`).
+    `chain` provides: clock-ish current slot (fork_choice.current_slot),
+    seen_attesters, fork_choice, head state ctx."""
+    p = chain.p
+    data = attestation.data
+    target_epoch = data.target.epoch
+    current_slot = chain.fork_choice.current_slot
+
+    # [REJECT] one committee bit set exactly
+    bits = list(attestation.aggregation_bits)
+    if sum(1 for b in bits if b) != 1:
+        raise GossipValidationError(GossipAction.REJECT, "not exactly one aggregation bit")
+    # [REJECT] epoch matches slot
+    if target_epoch != compute_epoch_at_slot(data.slot, p):
+        raise GossipValidationError(GossipAction.REJECT, "target epoch != slot epoch")
+    # [IGNORE] propagation window (slot +/- ATTESTATION_PROPAGATION_SLOT_RANGE)
+    if not (data.slot <= current_slot <= data.slot + 32):
+        raise GossipValidationError(GossipAction.IGNORE, "outside propagation window")
+    # [IGNORE] known block root
+    head_root_hex = "0x" + bytes(data.beacon_block_root).hex()
+    block = chain.fork_choice.proto_array.get_block(head_root_hex)
+    if block is None:
+        raise GossipValidationError(GossipAction.IGNORE, "unknown beacon block root")
+    # [REJECT] target must be the epoch-start ancestor of the attested block
+    target_slot = target_epoch * p.SLOTS_PER_EPOCH
+    expected_target = chain.fork_choice.proto_array._ancestor_or_none(head_root_hex, target_slot)
+    if expected_target is None or bytes.fromhex(expected_target[2:]) != bytes(data.target.root):
+        raise GossipValidationError(GossipAction.REJECT, "target is not the block's epoch ancestor")
+    state = _state_dialed_to(chain, bytes(data.beacon_block_root), data.slot)
+    ctx = EpochContext(state, p)
+    try:
+        committee = ctx.get_beacon_committee(data.slot, data.index)
+    except ValueError as e:
+        raise GossipValidationError(GossipAction.REJECT, f"bad committee: {e}") from e
+    if len(bits) != len(committee):
+        raise GossipValidationError(GossipAction.REJECT, "bits/committee length mismatch")
+    attesting = [int(committee[i]) for i, b in enumerate(bits) if b]
+    vi = attesting[0]
+    # [IGNORE] first-seen per (target epoch, validator)
+    if chain.seen_attesters.is_known(target_epoch, vi):
+        raise GossipValidationError(GossipAction.IGNORE, "already seen attester")
+
+    from lodestar_tpu.state_transition.block import get_indexed_attestation
+
+    indexed = get_indexed_attestation(attestation, ctx)
+    sig_set = indexed_attestation_signature_set(state, indexed, ctx)
+    chain.seen_attesters.add(target_epoch, vi)
+    return AttestationValidationResult(
+        indexed_attestation=indexed,
+        attesting_indices=attesting,
+        signature_sets=[sig_set],
+    )
+
+
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+
+
+def is_aggregator(committee_len: int, slot_signature: bytes) -> bool:
+    """Spec is_aggregator: hash(sig) mod max(1, len//TARGET) == 0
+    (reference `state-transition/src/util/aggregator.ts`)."""
+    modulo = max(1, committee_len // TARGET_AGGREGATORS_PER_COMMITTEE)
+    h = hashlib.sha256(slot_signature).digest()
+    return int.from_bytes(h[:8], "little") % modulo == 0
+
+
+def validate_gossip_aggregate_and_proof(chain, signed_agg) -> AttestationValidationResult:
+    """beacon_aggregate_and_proof checks (reference `aggregateAndProof.ts`):
+    structure + aggregator membership/selection + the three signature
+    sets (selection proof, aggregate-and-proof envelope, aggregate)."""
+    p = chain.p
+    t = ssz_types(p)
+    agg = signed_agg.message
+    attestation = agg.aggregate
+    data = attestation.data
+    current_slot = chain.fork_choice.current_slot
+
+    if not (data.slot <= current_slot <= data.slot + 32):
+        raise GossipValidationError(GossipAction.IGNORE, "outside propagation window")
+    if data.target.epoch != compute_epoch_at_slot(data.slot, p):
+        raise GossipValidationError(GossipAction.REJECT, "target epoch != slot epoch")
+    root_hex = "0x" + bytes(data.beacon_block_root).hex()
+    if chain.fork_choice.proto_array.get_block(root_hex) is None:
+        raise GossipValidationError(GossipAction.IGNORE, "unknown beacon block root")
+    target_slot = data.target.epoch * p.SLOTS_PER_EPOCH
+    expected_target = chain.fork_choice.proto_array._ancestor_or_none(root_hex, target_slot)
+    if expected_target is None or bytes.fromhex(expected_target[2:]) != bytes(data.target.root):
+        raise GossipValidationError(GossipAction.REJECT, "target is not the block's epoch ancestor")
+
+    state = _state_dialed_to(chain, bytes(data.beacon_block_root), data.slot)
+    ctx = EpochContext(state, p)
+    try:
+        committee = ctx.get_beacon_committee(data.slot, data.index)
+    except ValueError as e:
+        raise GossipValidationError(GossipAction.REJECT, f"bad committee: {e}") from e
+    # [REJECT] aggregator in committee
+    if agg.aggregator_index not in [int(i) for i in committee]:
+        raise GossipValidationError(GossipAction.REJECT, "aggregator not in committee")
+    # [REJECT] selection proof selects the aggregator
+    if not is_aggregator(len(committee), bytes(agg.selection_proof)):
+        raise GossipValidationError(GossipAction.REJECT, "selection proof does not select")
+
+    from lodestar_tpu import ssz
+    from lodestar_tpu.state_transition.block import get_indexed_attestation
+
+    aggregator = state.validators[agg.aggregator_index]
+    sets = [
+        # selection proof over the slot
+        SignatureSet(
+            pubkey=bytes(aggregator.pubkey),
+            message=compute_signing_root(
+                ssz.uint64, data.slot, get_domain(state, DOMAIN_SELECTION_PROOF, data.target.epoch)
+            ),
+            signature=bytes(agg.selection_proof),
+        ),
+        # aggregate-and-proof envelope
+        SignatureSet(
+            pubkey=bytes(aggregator.pubkey),
+            message=compute_signing_root(
+                t.AggregateAndProof, agg, get_domain(state, DOMAIN_AGGREGATE_AND_PROOF, data.target.epoch)
+            ),
+            signature=bytes(signed_agg.signature),
+        ),
+    ]
+    indexed = get_indexed_attestation(attestation, ctx)
+    sets.append(indexed_attestation_signature_set(state, indexed, ctx))
+    return AttestationValidationResult(
+        indexed_attestation=indexed,
+        attesting_indices=[int(i) for i in indexed.attesting_indices],
+        signature_sets=sets,
+    )
+
+
+def validate_gossip_block(chain, signed_block) -> None:
+    """beacon_block topic checks (reference `validation/block.ts`)."""
+    p = chain.p
+    block = signed_block.message
+    current_slot = chain.fork_choice.current_slot
+    if block.slot > current_slot:
+        raise GossipValidationError(GossipAction.IGNORE, "future slot")
+    finalized_slot = chain.fork_choice.finalized.epoch * p.SLOTS_PER_EPOCH
+    if block.slot <= finalized_slot:
+        raise GossipValidationError(GossipAction.IGNORE, "finalized slot")
+    root_hex = "0x" + bytes(block.parent_root).hex()
+    if chain.fork_choice.proto_array.get_block(root_hex) is None:
+        raise GossipValidationError(GossipAction.IGNORE, "parent unknown")
+    t = chain.types
+    block_root = t.phase0.BeaconBlock.hash_tree_root(block)
+    if chain.fork_choice.proto_array.has_block("0x" + block_root.hex()):
+        raise GossipValidationError(GossipAction.IGNORE, "already known")

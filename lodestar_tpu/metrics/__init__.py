@@ -1,0 +1,234 @@
+"""Metrics registry + beacon metric taxonomy + scrape server.
+
+Reference `beacon-node/src/metrics/` — `RegistryMetricCreator`
+(`utils/registryMetricCreator.ts`), the lodestar metric groups
+(`metrics/lodestar.ts`, incl. the blsThreadPool.* latency decomposition
+at :358-430 and the state-transition timers at :279,302), and the HTTP
+scrape server (`server/http.ts:14`). Built on prometheus_client (in
+image); metric names keep the reference's so existing Grafana dashboards
+(`dashboards/lodestar_bls_thread_pool.json`, ...) read unmodified.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+__all__ = ["RegistryMetricCreator", "BeaconMetrics", "create_metrics", "MetricsServer"]
+
+
+class RegistryMetricCreator:
+    """Typed factory bound to one registry (reference
+    `registryMetricCreator.ts`)."""
+
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+
+    def gauge(self, name: str, help_: str, labels: Sequence[str] = ()) -> Gauge:
+        return Gauge(name, help_, labelnames=list(labels), registry=self.registry)
+
+    def counter(self, name: str, help_: str, labels: Sequence[str] = ()) -> Counter:
+        return Counter(name, help_, labelnames=list(labels), registry=self.registry)
+
+    def histogram(
+        self, name: str, help_: str, buckets: Sequence[float], labels: Sequence[str] = ()
+    ) -> Histogram:
+        return Histogram(
+            name, help_, labelnames=list(labels), buckets=list(buckets), registry=self.registry
+        )
+
+    def scrape(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+@dataclass
+class BlsPoolMetrics:
+    """blsThreadPool.* (reference `metrics/lodestar.ts:358-430`) — the
+    worker-pool latency decomposition retargeted at the device pipeline."""
+
+    job_wait_time: Histogram
+    jobs_started: Counter
+    sig_sets_started: Counter
+    success_sets: Counter
+    error_sets: Counter
+    batch_retries: Counter
+    batch_sigs_success: Counter
+    time_per_sig_set: Histogram
+    latency_to_device: Histogram
+    latency_from_device: Histogram
+
+
+@dataclass
+class StateTransitionMetrics:
+    epoch_transition_time: Histogram
+    process_block_time: Histogram
+    state_hash_tree_root_time: Histogram
+
+
+@dataclass
+class GossipMetrics:
+    queue_length: Gauge
+    queue_dropped: Counter
+    accepted: Counter
+    rejected: Counter
+
+
+@dataclass
+class ForkChoiceMetrics:
+    find_head_time: Histogram
+    requests: Counter
+    errors: Counter
+    reorgs: Counter
+
+
+@dataclass
+class BeaconMetrics:
+    creator: RegistryMetricCreator
+    bls_pool: BlsPoolMetrics
+    state_transition: StateTransitionMetrics
+    gossip: GossipMetrics
+    fork_choice: ForkChoiceMetrics
+    head_slot: Gauge
+    finalized_epoch: Gauge
+    justified_epoch: Gauge
+    peers: Gauge
+
+    def scrape(self) -> bytes:
+        return self.creator.scrape()
+
+
+_SEC_SMALL = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5)
+_SEC_TINY = (0.0001, 0.001, 0.01, 0.1, 1)
+
+
+def create_metrics() -> BeaconMetrics:
+    """Reference `createMetrics` (`metrics/metrics.ts:14`)."""
+    c = RegistryMetricCreator()
+    bls = BlsPoolMetrics(
+        job_wait_time=c.histogram(
+            "lodestar_bls_thread_pool_queue_job_wait_time_seconds",
+            "Time a job waited in queue before execution", _SEC_SMALL,
+        ),
+        jobs_started=c.counter(
+            "lodestar_bls_thread_pool_jobs_started_total", "Jobs started"
+        ),
+        sig_sets_started=c.counter(
+            "lodestar_bls_thread_pool_sig_sets_started_total", "Signature sets started"
+        ),
+        success_sets=c.counter(
+            "lodestar_bls_thread_pool_success_jobs_signature_sets_count", "Successful sets"
+        ),
+        error_sets=c.counter(
+            "lodestar_bls_thread_pool_error_jobs_signature_sets_count", "Errored sets"
+        ),
+        batch_retries=c.counter(
+            "lodestar_bls_thread_pool_batch_retries_total", "Invalid batches retried individually"
+        ),
+        batch_sigs_success=c.counter(
+            "lodestar_bls_thread_pool_batch_sigs_success_total", "Sets verified in successful batches"
+        ),
+        time_per_sig_set=c.histogram(
+            "lodestar_bls_thread_pool_time_per_sig_set_seconds", "Device time per set", _SEC_TINY,
+        ),
+        latency_to_device=c.histogram(
+            "lodestar_bls_thread_pool_latency_to_worker", "Dispatch latency", _SEC_TINY,
+        ),
+        latency_from_device=c.histogram(
+            "lodestar_bls_thread_pool_latency_from_worker", "Result latency", _SEC_TINY,
+        ),
+    )
+    st = StateTransitionMetrics(
+        epoch_transition_time=c.histogram(
+            "lodestar_stfn_epoch_transition_seconds", "Epoch transition time", _SEC_SMALL
+        ),
+        process_block_time=c.histogram(
+            "lodestar_stfn_process_block_seconds", "Block processing time", _SEC_SMALL
+        ),
+        state_hash_tree_root_time=c.histogram(
+            "lodestar_stfn_hash_tree_root_seconds", "State hashTreeRoot time", _SEC_SMALL
+        ),
+    )
+    gossip = GossipMetrics(
+        queue_length=c.gauge(
+            "lodestar_gossip_validation_queue_length", "Gossip queue length", ["topic"]
+        ),
+        queue_dropped=c.counter(
+            "lodestar_gossip_validation_queue_dropped_jobs_total", "Dropped gossip jobs", ["topic"]
+        ),
+        accepted=c.counter(
+            "lodestar_gossip_validation_accept_total", "Accepted gossip objects", ["topic"]
+        ),
+        rejected=c.counter(
+            "lodestar_gossip_validation_reject_total", "Rejected gossip objects", ["topic"]
+        ),
+    )
+    fc = ForkChoiceMetrics(
+        find_head_time=c.histogram(
+            "lodestar_fork_choice_find_head_seconds", "findHead time", _SEC_TINY
+        ),
+        requests=c.counter("lodestar_fork_choice_requests_total", "findHead calls"),
+        errors=c.counter("lodestar_fork_choice_errors_total", "fork choice errors"),
+        reorgs=c.counter("lodestar_fork_choice_reorg_events_total", "reorg events"),
+    )
+    return BeaconMetrics(
+        creator=c,
+        bls_pool=bls,
+        state_transition=st,
+        gossip=gossip,
+        fork_choice=fc,
+        head_slot=c.gauge("beacon_head_slot", "Current head slot"),
+        finalized_epoch=c.gauge("beacon_finalized_epoch", "Finalized epoch"),
+        justified_epoch=c.gauge("beacon_current_justified_epoch", "Justified epoch"),
+        peers=c.gauge("libp2p_peers", "Connected peers"),
+    )
+
+
+class MetricsServer:
+    """Minimal /metrics scrape endpoint (reference `server/http.ts:14`)."""
+
+    def __init__(self, metrics: BeaconMetrics, port: int = 8008, host: str = "127.0.0.1"):
+        self.metrics = metrics
+        self.port = port
+        self.host = host
+        self._httpd = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        import http.server
+
+        metrics = self.metrics
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") == "/metrics":
+                    body = metrics.scrape()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,64 @@
+"""Batched multi-scalar multiplication (MSM) on device.
+
+Replaces the reference's main-thread pubkey aggregation
+(`chain/bls/multithread/index.ts:152,177` PublicKey.aggregate) and backs
+the 512-pubkey fast-aggregate-verify workload (BASELINE config 3); the
+same kernel is the core KZG needs later.
+
+TPU-first design note: classic Pippenger minimizes *scalar op count*
+(N + 2^w adds per window) via data-dependent bucket scatter — the wrong
+shape for SIMD lockstep. On a vector unit the batch dimension is free and
+**sequential depth** is the cost, so this MSM is a select-based batched
+double-and-add: all N points advance through the bit schedule in lockstep
+(`scalar_mul_var`, depth = nbits) followed by one log2(N) tree fold
+(`fold_sum`). Depth 255+9 for a 512-point G1 MSM vs Pippenger's
+windows x bucket-reduction serial chain — and zero gather/scatter.
+
+Plain (scalar-free) aggregation is just the fold.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import curve as cv
+from . import fp
+from . import tower as tw
+
+__all__ = ["bits_msb", "msm_g1", "msm_g2", "aggregate_points_g1"]
+
+
+def bits_msb(scalars, width: int) -> np.ndarray:
+    """(N,) ints -> (N, width) int32 bit matrix, MSB first."""
+    out = np.zeros((len(scalars), width), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        s = int(s)
+        for j in range(width):
+            out[i, j] = (s >> (width - 1 - j)) & 1
+    return out
+
+
+def msm_g1(points_aff, bit_matrix):
+    """sum_i scalar_i * P_i over G1.
+
+    points_aff: (x, y) mont-form (N, 32) arrays; bit_matrix: (N, nbits)
+    int32 MSB-first. Returns a Jacobian point (no batch dim).
+    Scalar 0 rows contribute infinity (their running point stays Z=0).
+    """
+    acc = cv.scalar_mul_var(cv.F1, points_aff, bit_matrix, fp.one_mont())
+    return cv.fold_sum(cv.F1, acc)
+
+
+def msm_g2(points_aff, bit_matrix):
+    """sum_i scalar_i * Q_i over the G2 twist ((N, 2, 32) coords)."""
+    acc = cv.scalar_mul_var(cv.F2, points_aff, bit_matrix, tw.fp2_one())
+    return cv.fold_sum(cv.F2, acc)
+
+
+def aggregate_points_g1(points_aff):
+    """Plain sum of N affine G1 points (pubkey aggregation): one tree
+    fold, no scalars."""
+    x, y = points_aff
+    one = fp.one_mont()
+    jac = cv.affine_to_jac(cv.F1, (x, y), one)
+    return cv.fold_sum(cv.F1, jac)

@@ -1,0 +1,178 @@
+"""Clock, metrics registry, op pools, seen caches."""
+
+from __future__ import annotations
+
+import urllib.request
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.clock import Clock
+from lodestar_tpu.chain.op_pools import (
+    AggregatedAttestationPool,
+    AttestationPool,
+    InsertOutcome,
+    OpPool,
+    SeenAttesters,
+)
+from lodestar_tpu.metrics import MetricsServer, create_metrics
+from lodestar_tpu.types import ssz_types
+
+
+# -- clock --------------------------------------------------------------------
+
+
+class FakeTime:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _clock(t0=0.0, genesis=100):
+    ft = FakeTime(t0)
+    return Clock(genesis_time=genesis, seconds_per_slot=12, slots_per_epoch=8, time_fn=ft), ft
+
+
+def test_clock_slot_epoch_math():
+    clock, ft = _clock(t0=100 + 12 * 19 + 3)
+    assert clock.current_slot == 19
+    assert clock.current_epoch == 2
+    assert clock.time_at_slot(19) == 100 + 228
+    assert clock.sec_from_slot(19) == pytest.approx(3)
+
+
+def test_clock_gossip_disparity():
+    clock, ft = _clock(t0=100 + 12 * 5 + 11.8)  # 200ms before slot 6
+    assert clock.current_slot == 5
+    assert clock.current_slot_with_gossip_disparity == 6
+    assert clock.is_current_slot_given_gossip_disparity(5)
+    assert clock.is_current_slot_given_gossip_disparity(6)
+    assert not clock.is_current_slot_given_gossip_disparity(7)
+    ft.t = 100 + 12 * 5 + 2
+    assert clock.current_slot_with_gossip_disparity == 5
+
+
+def test_clock_before_genesis_clamps():
+    clock, _ = _clock(t0=50)
+    assert clock.current_slot == 0
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+def test_metrics_taxonomy_and_scrape_server():
+    m = create_metrics()
+    m.bls_pool.jobs_started.inc()
+    m.bls_pool.batch_sigs_success.inc(32)
+    m.head_slot.set(1234)
+    m.state_transition.epoch_transition_time.observe(0.123)
+    body = m.scrape().decode()
+    assert "lodestar_bls_thread_pool_jobs_started_total 1.0" in body
+    assert "lodestar_bls_thread_pool_batch_sigs_success_total 32.0" in body
+    assert "beacon_head_slot 1234.0" in body
+
+    srv = MetricsServer(m, port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics") as r:
+            assert b"beacon_head_slot" in r.read()
+    finally:
+        srv.stop()
+
+
+# -- pools --------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def _att(slot=1, bit=0, nbits=4, sig=b"\x01"):
+    t = ssz_types()
+    att = t.Attestation.default()
+    att.data.slot = slot
+    bits = [False] * nbits
+    bits[bit] = True
+    att.aggregation_bits = bits
+    att.signature = sig * 96
+    return att
+
+
+def test_attestation_pool_naive_aggregation(monkeypatch):
+    # avoid real G2 aggregation cost: join sigs with a fake aggregator
+    import lodestar_tpu.chain.op_pools as op
+
+    monkeypatch.setattr(op, "aggregate_signatures", lambda sigs: bytes(96))
+    pool = AttestationPool()
+    root = b"\x11" * 32
+    assert pool.add(_att(bit=0, sig=b"\x01"), root) is InsertOutcome.NEW_DATA
+    assert pool.add(_att(bit=2, sig=b"\x02"), root) is InsertOutcome.AGGREGATED
+    assert pool.add(_att(bit=0, sig=b"\x01"), root) is InsertOutcome.ALREADY_KNOWN
+    agg = pool.get_aggregate(1, root)
+    assert agg.aggregation_bits == [True, False, True, False]
+    # pruning: old slots rejected
+    pool.prune(clock_slot=10)
+    assert pool.add(_att(slot=2), root) is InsertOutcome.OLD
+    assert pool.attestation_count() == 0
+
+
+def test_aggregated_pool_block_packing(minimal_preset):
+    p = minimal_preset
+    pool = AggregatedAttestationPool()
+    att1 = _att(slot=1, bit=0)
+    att2 = _att(slot=1, bit=1)
+    pool.add(att1, b"\x01" * 32)
+    pool.add(att2, b"\x02" * 32)
+
+    t = ssz_types()
+    state = t.phase0.BeaconState.default()
+    state.slot = 2
+    out = pool.get_attestations_for_block(state, p)
+    assert len(out) == 2
+    # subset aggregate rejected as known
+    assert pool.add(att1, b"\x01" * 32) is InsertOutcome.ALREADY_KNOWN
+
+
+def test_op_pool_dedup_and_packing(minimal_preset):
+    p = minimal_preset
+    from lodestar_tpu.params import FAR_FUTURE_EPOCH
+
+    t = ssz_types()
+    pool = OpPool()
+    ex = t.SignedVoluntaryExit.default()
+    ex.message.validator_index = 3
+    pool.insert_voluntary_exit(ex)
+    pool.insert_voluntary_exit(ex)
+    assert pool.has_exit(3)
+
+    state = t.phase0.BeaconState.default()
+    vals = []
+    for i in range(5):
+        v = t.Validator.default()
+        v.exit_epoch = FAR_FUTURE_EPOCH
+        v.withdrawable_epoch = FAR_FUTURE_EPOCH
+        vals.append(v)
+    state.validators = vals
+    atts, props, exits = pool.get_slashings_and_exits(state, p)
+    assert exits == [ex]
+    # after the validator exited, the pool prunes it
+    state.validators[3].exit_epoch = 5
+    pool.prune_all(state)
+    assert not pool.has_exit(3)
+
+
+def test_seen_attesters():
+    seen = SeenAttesters()
+    assert not seen.is_known(1, 42)
+    seen.add(1, 42)
+    assert seen.is_known(1, 42)
+    seen.prune(finalized_epoch=2)
+    assert not seen.is_known(1, 42)
+    with pytest.raises(ValueError):
+        seen.add(1, 7)

@@ -1,0 +1,146 @@
+"""Gossip validation: accept/ignore/reject semantics per the spec topics."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.validation import (
+    GossipAction,
+    GossipValidationError,
+    validate_gossip_attestation,
+    validate_gossip_block,
+)
+from lodestar_tpu.crypto.bls.api import verify_signature_sets
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.state_transition import EpochContext, compute_signing_root, get_domain, process_slots
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.types import ssz_types
+
+from .test_chain import _chain_of_blocks
+
+N = 32
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+@pytest.fixture(scope="module")
+def env(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=2,
+    )
+    blocks = _chain_of_blocks(genesis, sks, p, 2)
+
+    async def go():
+        for b in blocks:
+            await chain.process_block(b)
+
+    asyncio.run(go())
+    return p, sks, genesis, chain, blocks
+
+
+def _gossip_att(env, *, vi_bit=0, slot=2, sign=True):
+    p, sks, genesis, chain, blocks = env
+    t = ssz_types(p)
+    state = chain.get_head_state()
+    work = state.copy()
+    if slot > work.slot:
+        process_slots(work, slot, p)
+    ctx = EpochContext(work, p)
+    committee = ctx.get_beacon_committee(slot, 0)
+    att = t.Attestation.default()
+    att.data.slot = slot
+    att.data.index = 0
+    att.data.beacon_block_root = chain.head_root
+    att.data.target.epoch = slot // p.SLOTS_PER_EPOCH
+    # spec target: the block at (or last before) the target epoch's start
+    from lodestar_tpu.state_transition.util import get_block_root
+
+    try:
+        att.data.target.root = get_block_root(work, att.data.target.epoch, p)
+    except ValueError:
+        att.data.target.root = chain.head_root
+    att.data.source = work.current_justified_checkpoint
+    bits = [False] * len(committee)
+    bits[vi_bit] = True
+    att.aggregation_bits = bits
+    if sign:
+        from lodestar_tpu.crypto.bls.api import sign as bls_sign
+        from lodestar_tpu.params import DOMAIN_BEACON_ATTESTER
+
+        vi = int(committee[vi_bit])
+        domain = get_domain(work, DOMAIN_BEACON_ATTESTER, att.data.target.epoch)
+        root = compute_signing_root(t.AttestationData, att.data, domain)
+        att.signature = bls_sign(sks[vi], root)
+    return att
+
+
+def test_attestation_accepts_and_yields_verifiable_set(env):
+    p, sks, genesis, chain, blocks = env
+    att = _gossip_att(env)
+    res = validate_gossip_attestation(chain, att)
+    assert len(res.attesting_indices) == 1
+    assert verify_signature_sets(res.signature_sets)
+
+
+def test_attestation_first_seen_dedup(env):
+    p, sks, genesis, chain, blocks = env
+    att = _gossip_att(env, vi_bit=1)
+    validate_gossip_attestation(chain, att)
+    with pytest.raises(GossipValidationError) as ei:
+        validate_gossip_attestation(chain, att)
+    assert ei.value.action is GossipAction.IGNORE
+
+
+def test_attestation_rejects_multi_bit(env):
+    p, sks, genesis, chain, blocks = env
+    att = _gossip_att(env, sign=False)
+    bits = list(att.aggregation_bits)
+    bits[2] = True
+    att.aggregation_bits = bits
+    with pytest.raises(GossipValidationError) as ei:
+        validate_gossip_attestation(chain, att)
+    assert ei.value.action is GossipAction.REJECT
+
+
+def test_attestation_ignores_unknown_root(env):
+    p, sks, genesis, chain, blocks = env
+    att = _gossip_att(env, vi_bit=3, sign=False)
+    att.data.beacon_block_root = b"\x5c" * 32
+    with pytest.raises(GossipValidationError) as ei:
+        validate_gossip_attestation(chain, att)
+    assert ei.value.action is GossipAction.IGNORE
+
+
+def test_block_gossip_checks(env):
+    p, sks, genesis, chain, blocks = env
+    # known block -> IGNORE
+    with pytest.raises(GossipValidationError):
+        validate_gossip_block(chain, blocks[-1])
+    # future slot -> IGNORE
+    fut = blocks[-1].copy()
+    fut.message.slot = 50
+    with pytest.raises(GossipValidationError):
+        validate_gossip_block(chain, fut)
+    # unknown parent -> IGNORE
+    orphan = blocks[-1].copy()
+    orphan.message.slot = 2
+    orphan.message.parent_root = b"\x99" * 32
+    with pytest.raises(GossipValidationError):
+        validate_gossip_block(chain, orphan)

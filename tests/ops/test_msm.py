@@ -1,0 +1,53 @@
+"""MSM vs the CPU oracle: random scalars/points, zero scalars, aggregation."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.ops import curve as cv
+from lodestar_tpu.ops import fp
+from lodestar_tpu.ops import msm
+
+from .util import g1_from_jac_dev, g1_to_dev
+
+
+def _single(pt):
+    """Unbatched Jacobian point -> oracle affine (None = infinity)."""
+    return g1_from_jac_dev(tuple(np.asarray(c)[None] for c in pt))[0]
+
+
+def _oracle_msm(points, scalars):
+    acc = None
+    for pt, s in zip(points, scalars):
+        term = C.g1_mul(pt, s)
+        acc = C.g1_add(acc, term)
+    return acc
+
+
+@pytest.mark.parametrize("n,width", [(4, 16), (9, 64)])
+def test_msm_g1_matches_oracle(n, width):
+    rng = random.Random(42 + n)
+    points = [C.g1_mul(C.G1_GEN, rng.randrange(1, C.R)) for _ in range(n)]
+    scalars = [rng.randrange(0, 1 << width) for _ in range(n)]
+    dev_pts = g1_to_dev(points)
+    out = msm.msm_g1(dev_pts, msm.bits_msb(scalars, width))
+    assert _single(out) == _oracle_msm(points, scalars)
+
+
+def test_msm_zero_scalars_and_aggregate():
+    rng = random.Random(7)
+    points = [C.g1_mul(C.G1_GEN, rng.randrange(1, C.R)) for _ in range(5)]
+    scalars = [0, 1, 0, 3, 0]
+    dev_pts = g1_to_dev(points)
+    out = msm.msm_g1(dev_pts, msm.bits_msb(scalars, 8))
+    assert _single(out) == _oracle_msm(points, scalars)
+
+    agg = msm.aggregate_points_g1(dev_pts)
+    expect = None
+    for pt in points:
+        expect = C.g1_add(expect, pt)
+    assert _single(agg) == expect
