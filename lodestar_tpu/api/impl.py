@@ -1,0 +1,300 @@
+"""Beacon API implementation over BeaconChain.
+
+Reference `beacon-node/src/api/impl/` — each method returns plain JSON-
+ready dicts ({"data": ...} envelopes per the Eth Beacon API spec), using
+the generic eth2-JSON codecs over the registry types.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from lodestar_tpu.ssz.json import from_json, to_json
+from lodestar_tpu.state_transition import EpochContext, compute_epoch_at_slot, process_slots
+from lodestar_tpu.types import ssz_types
+
+__all__ = ["BeaconApiImpl", "ApiError"]
+
+VERSION = "lodestar-tpu/0.3.0"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class BeaconApiImpl:
+    def __init__(self, chain):
+        self.chain = chain
+        self.p = chain.p
+        self.t = ssz_types(chain.p)
+
+    # -- state resolution -----------------------------------------------------
+
+    def _state_at(self, state_id: str):
+        """Beacon API stateId: head | finalized | <slot> | 0x<state root>."""
+        chain = self.chain
+        if state_id == "head":
+            return chain.get_head_state()
+        if state_id == "genesis":
+            raise ApiError(501, "genesis state queries need the archive")
+        if state_id == "finalized":
+            root = bytes.fromhex(chain.fork_choice.finalized.root[2:])
+            st = chain.state_cache.get(root) or chain.states_db.get(root)
+            if st is None:
+                raise ApiError(404, "finalized state not found")
+            return st
+        if state_id.startswith("0x"):
+            # hex stateId is a STATE root: fork choice nodes record their
+            # block's state_root, so resolve through them to the block root
+            for node in chain.fork_choice.proto_array.nodes:
+                if node.state_root == state_id:
+                    return chain.get_state_by_block_root(bytes.fromhex(node.block_root[2:]))
+            raise ApiError(404, f"state {state_id} not found")
+        if state_id.isdigit():
+            return chain.get_state_by_block_root(self._block_root(state_id))
+        raise ApiError(400, f"unsupported state id {state_id}")
+
+    # -- beacon namespace -----------------------------------------------------
+
+    def get_genesis(self) -> dict:
+        st = self.chain.get_head_state()
+        # fork version from the chain config when bound: the head state's
+        # previous_version stops being the genesis version after any fork
+        if self.chain.cfg is not None:
+            version = self.chain.cfg.GENESIS_FORK_VERSION
+        else:
+            version = bytes(st.fork.previous_version)
+        return {
+            "data": {
+                "genesis_time": str(st.genesis_time),
+                "genesis_validators_root": "0x" + bytes(st.genesis_validators_root).hex(),
+                "genesis_fork_version": "0x" + version.hex(),
+            }
+        }
+
+    def get_block_header(self, block_id: str) -> dict:
+        root = self._block_root(block_id)
+        signed = self.chain.blocks_db.get(root)
+        if signed is None:
+            raise ApiError(404, f"block {block_id} not found")
+        header = self.t.BeaconBlockHeader.default()
+        msg = signed.message
+        header.slot = msg.slot
+        header.proposer_index = msg.proposer_index
+        header.parent_root = bytes(msg.parent_root)
+        header.state_root = bytes(msg.state_root)
+        header.body_root = self.t.phase0.BeaconBlockBody.hash_tree_root(msg.body)
+        return {
+            "data": {
+                "root": "0x" + root.hex(),
+                "canonical": True,
+                "header": {
+                    "message": to_json(self.t.BeaconBlockHeader, header),
+                    "signature": "0x" + bytes(signed.signature).hex(),
+                },
+            }
+        }
+
+    def _block_root(self, block_id: str) -> bytes:
+        if block_id == "head":
+            return self.chain.head_root
+        if block_id.startswith("0x"):
+            return bytes.fromhex(block_id[2:])
+        # numeric slot: resolve through fork choice chain from head
+        slot = int(block_id)
+        node = self.chain.fork_choice.proto_array.get_block(self.chain.fork_choice.head)
+        while node is not None and node.slot > slot:
+            parent = node.parent
+            node = self.chain.fork_choice.proto_array.nodes[parent] if parent is not None else None
+        if node is None or node.slot != slot:
+            raise ApiError(404, f"no canonical block at slot {slot}")
+        return bytes.fromhex(node.block_root[2:])
+
+    def get_block_v2(self, block_id: str) -> dict:
+        root = self._block_root(block_id)
+        signed = self.chain.blocks_db.get(root)
+        if signed is None:
+            raise ApiError(404, f"block {block_id} not found")
+        return {
+            "version": "phase0",
+            "execution_optimistic": False,
+            "data": to_json(self.t.phase0.SignedBeaconBlock, signed),
+        }
+
+    def publish_block(self, body: dict) -> dict:
+        signed = from_json(self.t.phase0.SignedBeaconBlock, body)
+        from lodestar_tpu.chain.chain import BlockError
+
+        try:
+            asyncio.run(self.chain.process_block(signed))
+        except BlockError as e:
+            raise ApiError(400, str(e)) from e
+        return {}
+
+    def get_state_finality_checkpoints(self, state_id: str) -> dict:
+        st = self._state_at(state_id)
+        return {
+            "data": {
+                "previous_justified": to_json(self.t.Checkpoint, st.previous_justified_checkpoint),
+                "current_justified": to_json(self.t.Checkpoint, st.current_justified_checkpoint),
+                "finalized": to_json(self.t.Checkpoint, st.finalized_checkpoint),
+            }
+        }
+
+    def get_state_fork(self, state_id: str) -> dict:
+        st = self._state_at(state_id)
+        return {"data": to_json(self.t.Fork, st.fork)}
+
+    def get_state_validators(self, state_id: str) -> dict:
+        st = self._state_at(state_id)
+        epoch = compute_epoch_at_slot(st.slot, self.p)
+        out = []
+        for i, v in enumerate(st.validators):
+            status = _validator_status(v, epoch)
+            out.append(
+                {
+                    "index": str(i),
+                    "balance": str(st.balances[i]),
+                    "status": status,
+                    "validator": to_json(self.t.Validator, v),
+                }
+            )
+        return {"data": out}
+
+    def submit_pool_attestations(self, body: list) -> dict:
+        from lodestar_tpu.chain.validation import GossipValidationError, validate_gossip_attestation
+
+        errors = []
+        for i, att_json in enumerate(body):
+            att = from_json(self.t.Attestation, att_json)
+            try:
+                res = validate_gossip_attestation(self.chain, att)
+            except GossipValidationError as e:
+                errors.append({"index": i, "message": str(e)})
+                continue
+            root = self.t.AttestationData.hash_tree_root(att.data)
+            self.chain.attestation_pool.add(att, root)
+            self.chain.fork_choice.on_attestation(
+                res.attesting_indices,
+                "0x" + bytes(att.data.beacon_block_root).hex(),
+                att.data.target.epoch,
+                att.data.slot,
+            )
+        if errors:
+            raise ApiError(400, f"some attestations failed: {errors}")
+        return {}
+
+    # -- validator namespace --------------------------------------------------
+
+    def get_proposer_duties(self, epoch: int) -> dict:
+        from lodestar_tpu.chain.produce_block import dial_to_slot
+
+        st = self.chain.get_head_state()
+        target_slot = epoch * self.p.SLOTS_PER_EPOCH
+        work, ctx = dial_to_slot(st, max(target_slot, st.slot), self.p, self.chain.cfg)
+        if ctx.current_epoch != epoch:
+            raise ApiError(400, f"cannot compute duties for epoch {epoch}")
+        duties = []
+        for i, proposer in enumerate(ctx.proposers):
+            duties.append(
+                {
+                    "pubkey": "0x" + bytes(work.validators[proposer].pubkey).hex(),
+                    "validator_index": str(proposer),
+                    "slot": str(target_slot + i),
+                }
+            )
+        return {"data": duties, "dependent_root": self.chain.fork_choice.head}
+
+    def get_attester_duties(self, epoch: int, indices: list[int]) -> dict:
+        from lodestar_tpu.chain.produce_block import dial_to_slot
+
+        st = self.chain.get_head_state()
+        work, ctx = dial_to_slot(
+            st, max(epoch * self.p.SLOTS_PER_EPOCH, st.slot), self.p, self.chain.cfg
+        )
+        want = set(indices)
+        duties = []
+        sh = ctx._shuffling_at(epoch)
+        for slot_i in range(self.p.SLOTS_PER_EPOCH):
+            for c_idx, committee in enumerate(sh.committees[slot_i]):
+                for pos, vi in enumerate(committee):
+                    if int(vi) in want:
+                        duties.append(
+                            {
+                                "pubkey": "0x" + bytes(work.validators[int(vi)].pubkey).hex(),
+                                "validator_index": str(int(vi)),
+                                "committee_index": str(c_idx),
+                                "committee_length": str(len(committee)),
+                                "committees_at_slot": str(sh.committees_per_slot),
+                                "validator_committee_index": str(pos),
+                                "slot": str(epoch * self.p.SLOTS_PER_EPOCH + slot_i),
+                            }
+                        )
+        return {"data": duties, "dependent_root": self.chain.fork_choice.head}
+
+    def produce_block_v2(self, slot: int, randao_reveal: str, graffiti: str = "") -> dict:
+        from lodestar_tpu.chain.produce_block import produce_block
+
+        block = produce_block(
+            self.chain,
+            slot=slot,
+            randao_reveal=bytes.fromhex(randao_reveal[2:]),
+            graffiti=bytes.fromhex(graffiti[2:]) if graffiti.startswith("0x") else graffiti.encode(),
+        )
+        return {"version": "phase0", "data": to_json(self.t.phase0.BeaconBlock, block)}
+
+    def produce_attestation_data(self, slot: int, committee_index: int) -> dict:
+        from lodestar_tpu.chain.produce_block import make_attestation_data
+
+        data = make_attestation_data(self.chain, slot, committee_index)
+        return {"data": to_json(self.t.AttestationData, data)}
+
+    # -- node namespace -------------------------------------------------------
+
+    def get_health(self) -> int:
+        return 200
+
+    def get_version(self) -> dict:
+        return {"data": {"version": VERSION}}
+
+    def get_syncing_status(self) -> dict:
+        head = self.chain.fork_choice.proto_array.get_block(self.chain.fork_choice.head)
+        head_slot = head.slot if head else 0
+        current = self.chain.fork_choice.current_slot
+        return {
+            "data": {
+                "head_slot": str(head_slot),
+                "sync_distance": str(max(0, current - head_slot)),
+                "is_syncing": current - head_slot > 3,
+                "is_optimistic": False,
+            }
+        }
+
+    # -- debug / config -------------------------------------------------------
+
+    def get_debug_state_v2(self, state_id: str) -> dict:
+        st = self._state_at(state_id)
+        return {"version": "phase0", "data": to_json(st.type, st)}
+
+    def get_spec(self) -> dict:
+        p = self.p
+        fields = {
+            name: str(getattr(p, name))
+            for name in type(p).__dataclass_fields__  # type: ignore[attr-defined]
+        }
+        return {"data": fields}
+
+
+def _validator_status(v, epoch: int) -> str:
+    from lodestar_tpu.params import FAR_FUTURE_EPOCH
+
+    if v.activation_epoch > epoch:
+        return "pending_queued" if v.activation_eligibility_epoch != FAR_FUTURE_EPOCH else "pending_initialized"
+    if epoch < v.exit_epoch:
+        return "active_slashed" if v.slashed else "active_ongoing"
+    if epoch < v.withdrawable_epoch:
+        return "exited_slashed" if v.slashed else "exited_unslashed"
+    return "withdrawal_possible"

@@ -1,0 +1,185 @@
+"""REST server: Eth Beacon API routes over the impl.
+
+Reference `beacon-node/src/api/rest/base.ts:39` (fastify) — here a
+threaded stdlib HTTP server with a declarative route table, the same
+path shapes (`/eth/v1/...`, `/eth/v2/...`) so standard beacon clients
+interoperate.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Callable
+
+from .impl import ApiError, BeaconApiImpl
+
+__all__ = ["BeaconRestApiServer", "ROUTES"]
+
+# (method, path regex with named groups, handler name, kind)
+ROUTES: list[tuple[str, str, str]] = [
+    ("GET", r"/eth/v1/beacon/genesis", "r_genesis"),
+    ("GET", r"/eth/v1/beacon/headers/(?P<block_id>[^/]+)", "r_block_header"),
+    ("GET", r"/eth/v2/beacon/blocks/(?P<block_id>[^/]+)", "r_block_v2"),
+    ("POST", r"/eth/v1/beacon/blocks", "r_publish_block"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/finality_checkpoints", "r_finality"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/fork", "r_fork"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators", "r_validators"),
+    ("POST", r"/eth/v1/beacon/pool/attestations", "r_pool_attestations"),
+    ("GET", r"/eth/v1/validator/duties/proposer/(?P<epoch>\d+)", "r_proposer_duties"),
+    ("POST", r"/eth/v1/validator/duties/attester/(?P<epoch>\d+)", "r_attester_duties"),
+    ("GET", r"/eth/v2/validator/blocks/(?P<slot>\d+)", "r_produce_block"),
+    ("GET", r"/eth/v1/validator/attestation_data", "r_attestation_data"),
+    ("GET", r"/eth/v1/node/health", "r_health"),
+    ("GET", r"/eth/v1/node/version", "r_version"),
+    ("GET", r"/eth/v1/node/syncing", "r_syncing"),
+    ("GET", r"/eth/v2/debug/beacon/states/(?P<state_id>[^/]+)", "r_debug_state"),
+    ("GET", r"/eth/v1/config/spec", "r_spec"),
+]
+
+
+class _Router:
+    def __init__(self, api: BeaconApiImpl):
+        self.api = api
+        self.table = [
+            (method, re.compile("^" + pattern + "$"), getattr(self, handler))
+            for method, pattern, handler in ROUTES
+        ]
+
+    def dispatch(self, method: str, path: str, query: dict, body):
+        for m, rx, fn in self.table:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match:
+                return fn(query=query, body=body, **match.groupdict())
+        raise ApiError(404, f"route not found: {method} {path}")
+
+    # handlers — translate path/query/body into impl calls
+    def r_genesis(self, **kw):
+        return self.api.get_genesis()
+
+    def r_block_header(self, block_id, **kw):
+        return self.api.get_block_header(block_id)
+
+    def r_block_v2(self, block_id, **kw):
+        return self.api.get_block_v2(block_id)
+
+    def r_publish_block(self, body, **kw):
+        return self.api.publish_block(body)
+
+    def r_finality(self, state_id, **kw):
+        return self.api.get_state_finality_checkpoints(state_id)
+
+    def r_fork(self, state_id, **kw):
+        return self.api.get_state_fork(state_id)
+
+    def r_validators(self, state_id, **kw):
+        return self.api.get_state_validators(state_id)
+
+    def r_pool_attestations(self, body, **kw):
+        return self.api.submit_pool_attestations(body)
+
+    def r_proposer_duties(self, epoch, **kw):
+        return self.api.get_proposer_duties(int(epoch))
+
+    def r_attester_duties(self, epoch, body, **kw):
+        return self.api.get_attester_duties(int(epoch), [int(i) for i in body])
+
+    def r_produce_block(self, slot, query, **kw):
+        reveal = query.get("randao_reveal")
+        if not reveal:
+            raise ApiError(400, "missing required parameter: randao_reveal")
+        return self.api.produce_block_v2(int(slot), reveal, query.get("graffiti", ""))
+
+    def r_attestation_data(self, query, **kw):
+        return self.api.produce_attestation_data(
+            int(query["slot"]), int(query["committee_index"])
+        )
+
+    def r_health(self, **kw):
+        return self.api.get_health()
+
+    def r_version(self, **kw):
+        return self.api.get_version()
+
+    def r_syncing(self, **kw):
+        return self.api.get_syncing_status()
+
+    def r_debug_state(self, state_id, **kw):
+        return self.api.get_debug_state_v2(state_id)
+
+    def r_spec(self, **kw):
+        return self.api.get_spec()
+
+
+class BeaconRestApiServer:
+    def __init__(self, api: BeaconApiImpl, *, host: str = "127.0.0.1", port: int = 9596):
+        self.router = _Router(api)
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        import http.server
+        from urllib.parse import parse_qsl, urlsplit
+
+        router = self.router
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _run(self, method):
+                parts = urlsplit(self.path)
+                query = dict(parse_qsl(parts.query))
+                try:
+                    body = None
+                    if method == "POST":
+                        length = int(self.headers.get("Content-Length") or 0)
+                        raw = self.rfile.read(length) if length else b""
+                        try:
+                            body = json.loads(raw) if raw else None
+                        except json.JSONDecodeError as e:
+                            raise ApiError(400, f"malformed JSON body: {e}") from e
+                    out = router.dispatch(method, parts.path, query, body)
+                except ApiError as e:
+                    payload = json.dumps({"code": e.status, "message": e.message}).encode()
+                    self._reply(e.status, payload)
+                    return
+                except Exception as e:  # internal error fail-safe
+                    payload = json.dumps({"code": 500, "message": repr(e)}).encode()
+                    self._reply(500, payload)
+                    return
+                if isinstance(out, int):  # health-style status-only
+                    self.send_response(out)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self._reply(200, json.dumps(out).encode())
+
+            def _reply(self, status, payload: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                self._run("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._run("POST")
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

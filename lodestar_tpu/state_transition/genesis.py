@@ -39,13 +39,18 @@ def create_interop_genesis_state(
     p: BeaconPreset | None = None,
     eth1_block_hash: bytes = b"\x42" * 32,
     pubkeys: list[bytes] | None = None,
+    genesis_fork_version: bytes = b"\x00\x00\x00\x00",
 ):
     """Phase0 genesis BeaconState with n active interop validators."""
     p = p or active_preset()
     t = ssz_types(p)
     state = t.phase0.BeaconState.default()
     state.genesis_time = genesis_time
-    state.fork = t.Fork.default()  # phase0: previous == current == GENESIS_FORK_VERSION (zero)
+    # spec: previous == current == GENESIS_FORK_VERSION at genesis
+    fork = t.Fork.default()
+    fork.previous_version = genesis_fork_version
+    fork.current_version = genesis_fork_version
+    state.fork = fork
 
     # latest block header points at the empty body
     header = t.BeaconBlockHeader.default()

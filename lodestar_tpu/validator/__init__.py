@@ -1,0 +1,120 @@
+"""Validator client (reference `packages/validator/src`).
+
+`Validator` runs attestation + block-proposal duties per slot against an
+injected beacon API (in-process BeaconChain adapter or a REST client —
+the duty flow matches `validator.ts:187` + `services/attestation.ts` /
+`services/block.ts`); all signing flows through `ValidatorStore`, which
+is gated by the slashing-protection DB.
+"""
+
+from __future__ import annotations
+
+from lodestar_tpu.params import BeaconPreset, active_preset
+from lodestar_tpu.state_transition import EpochContext
+
+from .slashing_protection import SlashingError, SlashingProtection  # noqa: F401
+from .store import ValidatorStore  # noqa: F401
+
+__all__ = ["Validator", "ValidatorStore", "SlashingProtection", "SlashingError"]
+
+
+class Validator:
+    """Duty loop over an in-process chain (the reference's
+    getDevBeaconNode pattern): on each slot — propose if selected, attest
+    at the committee assignment."""
+
+    def __init__(self, *, chain, store: ValidatorStore, p: BeaconPreset | None = None):
+        self.chain = chain
+        self.store = store
+        self.p = p or active_preset()
+
+    async def run_slot_duties(self, slot: int) -> dict:
+        """Propose + attest for `slot`. Returns a summary of what was
+        produced (tests + dev runner introspection)."""
+        out = {"proposed": None, "attestations": []}
+        from lodestar_tpu.chain.produce_block import dial_to_slot
+
+        head_state = self.chain.get_head_state()
+        work, ctx = dial_to_slot(head_state, slot, self.p, self.chain.cfg)
+
+        # -- proposal (services/block.ts) --
+        proposer_index = ctx.get_beacon_proposer(slot)
+        proposer_pk = bytes(work.validators[proposer_index].pubkey)
+        if self.store.has_pubkey(proposer_pk):
+            from lodestar_tpu.chain.produce_block import produce_block
+
+            epoch = slot // self.p.SLOTS_PER_EPOCH
+            reveal = self.store.sign_randao(proposer_pk, epoch)
+            block = produce_block(self.chain, slot=slot, randao_reveal=reveal)
+            signed = self.store.sign_block(proposer_pk, block)
+            await self.chain.process_block(signed, is_timely=True)
+            out["proposed"] = signed
+            # duties for the rest of the slot run on the new head
+            work, ctx = dial_to_slot(self.chain.get_head_state(), slot, self.p, self.chain.cfg)
+
+        # -- attestations (services/attestation.ts) --
+        from lodestar_tpu.chain.produce_block import make_attestation_data
+        from lodestar_tpu.types import ssz_types
+
+        t = ssz_types(self.p)
+        epoch = slot // self.p.SLOTS_PER_EPOCH
+        for committee_index in range(ctx.get_committee_count_per_slot(epoch)):
+            committee = ctx.get_beacon_committee(slot, committee_index)
+            data = make_attestation_data(self.chain, slot, committee_index)
+            data_root = t.AttestationData.hash_tree_root(data)
+            for pos, vi in enumerate(committee):
+                pk = bytes(work.validators[int(vi)].pubkey)
+                if not self.store.has_pubkey(pk):
+                    continue
+                sig = self.store.sign_attestation(pk, data)
+                att = t.Attestation.default()
+                bits = [False] * len(committee)
+                bits[pos] = True
+                att.aggregation_bits = bits
+                att.data = data
+                att.signature = sig
+                out["attestations"].append(att)
+                self.chain.attestation_pool.add(att, data_root)
+                self.chain.fork_choice.on_attestation(
+                    [int(vi)], "0x" + bytes(data.beacon_block_root).hex(), epoch, slot
+                )
+
+        # -- aggregation round (services/attestation.ts second phase) --
+        out["aggregates"] = self._run_aggregation(slot, work, ctx, t)
+        return out
+
+    def _run_aggregation(self, slot: int, work, ctx, t) -> list:
+        """Selected aggregators publish SignedAggregateAndProof into the
+        aggregated pool block production packs from."""
+        from lodestar_tpu.chain.validation import is_aggregator
+
+        epoch = slot // self.p.SLOTS_PER_EPOCH
+        aggregates = []
+        for committee_index in range(ctx.get_committee_count_per_slot(epoch)):
+            committee = ctx.get_beacon_committee(slot, committee_index)
+            for vi in committee:
+                pk = bytes(work.validators[int(vi)].pubkey)
+                if not self.store.has_pubkey(pk):
+                    continue
+                proof = self.store.sign_selection_proof(pk, slot)
+                if not is_aggregator(len(committee), proof):
+                    continue
+                # aggregate what the naive pool collected for this data
+                data = None
+                for root, entry in list(
+                    self.chain.attestation_pool._by_slot.get(slot, {}).items()
+                ):
+                    if entry["data"].index != committee_index:
+                        continue
+                    agg_att = self.chain.attestation_pool.get_aggregate(slot, root)
+                    if agg_att is None:
+                        continue
+                    aap = t.AggregateAndProof.default()
+                    aap.aggregator_index = int(vi)
+                    aap.aggregate = agg_att
+                    aap.selection_proof = proof
+                    signed_agg = self.store.sign_aggregate_and_proof(pk, aap)
+                    aggregates.append(signed_agg)
+                    self.chain.aggregated_attestation_pool.add(agg_att, root)
+                break  # one aggregator per committee suffices locally
+        return aggregates

@@ -1,0 +1,122 @@
+"""REST API e2e: real HTTP server + client against a live chain —
+the cross-process surface the validator client uses (reference
+`test/e2e` style: two real subsystems over localhost)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.api import BeaconApiClient, BeaconApiImpl, BeaconRestApiServer
+from lodestar_tpu.api.client import ApiClientError
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.ssz.json import from_json, to_json
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.types import ssz_types
+
+from ..chain.test_chain import _chain_of_blocks
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+@pytest.fixture(scope="module")
+def env(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=2,
+    )
+    blocks = _chain_of_blocks(genesis, sks, p, 2)
+
+    async def go():
+        for b in blocks[:1]:
+            await chain.process_block(b)
+
+    asyncio.run(go())
+    server = BeaconRestApiServer(BeaconApiImpl(chain), port=0)
+    server.start()
+    client = BeaconApiClient(f"http://127.0.0.1:{server.port}")
+    yield p, chain, blocks, client
+    server.stop()
+
+
+def test_genesis_and_node_endpoints(env):
+    p, chain, blocks, client = env
+    g = client.get_genesis()["data"]
+    assert g["genesis_validators_root"].startswith("0x")
+    assert client.get_health() == 200
+    assert "lodestar-tpu" in client.get_version()["data"]["version"]
+    sync = client.get_syncing_status()["data"]
+    assert sync["head_slot"] == "1"
+
+
+def test_block_endpoints_roundtrip(env):
+    p, chain, blocks, client = env
+    t = ssz_types(p)
+    head = client.get_block_header("head")["data"]
+    assert head["header"]["message"]["slot"] == "1"
+    blk = client.get_block_v2("head")
+    assert blk["version"] == "phase0"
+    # wire JSON decodes back to the identical SSZ object
+    decoded = from_json(t.phase0.SignedBeaconBlock, blk["data"])
+    assert t.phase0.SignedBeaconBlock.hash_tree_root(decoded) == t.phase0.SignedBeaconBlock.hash_tree_root(blocks[0])
+    # by-slot and by-root resolution agree
+    root = head["root"]
+    assert client.get_block_v2(root)["data"] == blk["data"]
+    assert client.get_block_v2("1")["data"] == blk["data"]
+    with pytest.raises(ApiClientError):
+        client.get_block_v2("0x" + "77" * 32)
+
+
+def test_publish_block_via_api(env):
+    p, chain, blocks, client = env
+    t = ssz_types(p)
+    client.publish_block(to_json(t.phase0.SignedBeaconBlock, blocks[1]))
+    assert chain.head_root == t.phase0.BeaconBlock.hash_tree_root(blocks[1].message)
+    # republishing -> 400 ALREADY_KNOWN
+    with pytest.raises(ApiClientError) as ei:
+        client.publish_block(to_json(t.phase0.SignedBeaconBlock, blocks[1]))
+    assert ei.value.status == 400
+
+
+def test_state_and_duty_endpoints(env):
+    p, chain, blocks, client = env
+    fin = client.get_state_finality_checkpoints("head")["data"]
+    assert fin["finalized"]["epoch"] == "0"
+    fork = client.get_state_fork("head")["data"]
+    assert fork["current_version"] == "0x00000000"
+    vals = client.get_state_validators("head")["data"]
+    assert len(vals) == N
+    assert vals[0]["status"] == "active_ongoing"
+
+    duties = client.get_proposer_duties(0)["data"]
+    assert len(duties) == p.SLOTS_PER_EPOCH
+    att_duties = client.get_attester_duties(0, list(range(N)))["data"]
+    assert len(att_duties) == N  # every validator has exactly one duty
+
+    data = client.produce_attestation_data(2, 0)["data"]
+    assert data["slot"] == "2"
+
+    spec = client.get_spec()["data"]
+    assert spec["SLOTS_PER_EPOCH"] == "8"
+
+    dbg = client.get_debug_state_v2("head")
+    t = ssz_types(p)
+    st = from_json(t.phase0.BeaconState, dbg["data"])
+    assert st.slot == chain.get_head_state().slot

@@ -1,0 +1,104 @@
+"""Slashing protection: double votes, surround both directions, blocks,
+interchange roundtrip + lower bounds — EIP-3076-shaped scenarios."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.validator.slashing_protection import (
+    SlashingError,
+    SlashingErrorCode,
+    SlashingProtection,
+)
+
+PK = b"\xaa" * 48
+PK2 = b"\xbb" * 48
+
+
+def _sp():
+    return SlashingProtection(MemoryDbController())
+
+
+def _root(i):
+    return bytes([i]) * 32
+
+
+def test_double_vote_rejected_same_data_ok():
+    sp = _sp()
+    sp.check_and_insert_attestation(PK, 1, 2, _root(1))
+    # identical signing root: no-op
+    sp.check_and_insert_attestation(PK, 1, 2, _root(1))
+    with pytest.raises(SlashingError) as ei:
+        sp.check_and_insert_attestation(PK, 1, 2, _root(9))
+    assert ei.value.code == SlashingErrorCode.DOUBLE_VOTE
+
+
+def test_surrounding_vote_rejected():
+    sp = _sp()
+    sp.check_and_insert_attestation(PK, 3, 4, _root(1))
+    with pytest.raises(SlashingError) as ei:
+        sp.check_and_insert_attestation(PK, 2, 5, _root(2))  # surrounds (3,4)
+    assert ei.value.code == SlashingErrorCode.SURROUNDING_VOTE
+
+
+def test_surrounded_vote_rejected():
+    sp = _sp()
+    sp.check_and_insert_attestation(PK, 2, 7, _root(1))
+    with pytest.raises(SlashingError) as ei:
+        sp.check_and_insert_attestation(PK, 3, 4, _root(2))  # surrounded by (2,7)
+    assert ei.value.code == SlashingErrorCode.SURROUNDED_VOTE
+
+
+def test_normal_progression_accepted():
+    sp = _sp()
+    for e in range(1, 12):
+        sp.check_and_insert_attestation(PK, e, e + 1, _root(e))
+    # distinct validators are independent
+    sp.check_and_insert_attestation(PK2, 1, 2, _root(1))
+
+
+def test_source_exceeds_target():
+    sp = _sp()
+    with pytest.raises(SlashingError) as ei:
+        sp.check_and_insert_attestation(PK, 5, 4, _root(0))
+    assert ei.value.code == SlashingErrorCode.SOURCE_EXCEEDS_TARGET
+
+
+def test_double_block_proposal():
+    sp = _sp()
+    sp.check_and_insert_block_proposal(PK, 10, _root(1))
+    sp.check_and_insert_block_proposal(PK, 10, _root(1))  # same data ok
+    sp.check_and_insert_block_proposal(PK, 11, _root(2))
+    with pytest.raises(SlashingError) as ei:
+        sp.check_and_insert_block_proposal(PK, 10, _root(3))
+    assert ei.value.code == SlashingErrorCode.DOUBLE_BLOCK_PROPOSAL
+
+
+def test_interchange_roundtrip_and_lower_bound():
+    gvr = b"\x33" * 32
+    sp = _sp()
+    sp.check_and_insert_attestation(PK, 4, 5, _root(1))
+    sp.check_and_insert_block_proposal(PK, 40, _root(2))
+    exported = sp.export_interchange(gvr, [PK])
+    assert exported["metadata"]["interchange_format_version"] == "5"
+    assert len(exported["data"][0]["signed_attestations"]) == 1
+
+    # import into a fresh db
+    sp2 = _sp()
+    sp2.import_interchange(exported, gvr)
+    # the imported history gates: double vote at target 5 rejected
+    with pytest.raises(SlashingError):
+        sp2.check_and_insert_attestation(PK, 4, 5, _root(9))
+    # lower bounds: any target <= imported max rejected even if unseen
+    with pytest.raises(SlashingError):
+        sp2.check_and_insert_attestation(PK, 0, 3, _root(9))
+    with pytest.raises(SlashingError):
+        sp2.check_and_insert_block_proposal(PK, 39, _root(9))
+    # progress beyond imported history is fine
+    sp2.check_and_insert_attestation(PK, 5, 6, _root(5))
+    sp2.check_and_insert_block_proposal(PK, 41, _root(6))
+
+    # wrong genesis root refused
+    with pytest.raises(ValueError):
+        sp2.import_interchange(exported, b"\x00" * 32)
