@@ -1,0 +1,3 @@
+from lodestar_tpu.cli import main
+
+raise SystemExit(main())

@@ -158,6 +158,8 @@ class BeaconChain:
     # -- clock ----------------------------------------------------------------
 
     def on_slot(self, slot: int) -> None:
+        if slot <= self.fork_choice.current_slot:
+            return  # a stale timer tick must never rewind the store clock
         self.fork_choice.on_tick(slot)
         self.attestation_pool.prune(slot)
         self.aggregated_attestation_pool.prune(slot)

@@ -1,0 +1,145 @@
+"""CLI: beacon / validator / dev commands (reference `packages/cli/src`,
+`cli.ts:19` yargs tree; `dev` = in-process node + all validators, the
+`getDevBeaconNode` workflow).
+
+Usage:
+  python -m lodestar_tpu dev --validators 16 --slots 8 [--preset minimal]
+  python -m lodestar_tpu beacon --db ./chain-db [--rest-port 9596]
+  python -m lodestar_tpu bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+__all__ = ["main"]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="lodestar-tpu", description="TPU-native beacon chain framework")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    dev = sub.add_parser("dev", help="single-process dev chain: node + validators")
+    dev.add_argument("--validators", type=int, default=16)
+    dev.add_argument("--slots", type=int, default=8, help="slots to advance before exiting")
+    dev.add_argument("--preset", default="minimal", choices=["minimal", "mainnet"])
+    dev.add_argument("--rest-port", type=int, default=0)
+    dev.add_argument("--slot-time", type=float, default=0.0, help="seconds per slot (0 = as fast as possible)")
+
+    beacon = sub.add_parser("beacon", help="run a beacon node")
+    beacon.add_argument("--db", default=None, help="data directory (default: in-memory)")
+    beacon.add_argument("--rest-port", type=int, default=9596)
+    beacon.add_argument("--metrics-port", type=int, default=0)
+    beacon.add_argument("--preset", default="mainnet", choices=["minimal", "mainnet"])
+    beacon.add_argument("--genesis-validators", type=int, default=64)
+
+    sub.add_parser("bench", help="run the device benchmark")
+    return ap
+
+
+async def _run_dev(args) -> int:
+    from lodestar_tpu import params
+    from lodestar_tpu.config import create_beacon_config, minimal_chain_config
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.node import BeaconNode, BeaconNodeOptions
+    from lodestar_tpu.state_transition.genesis import (
+        create_interop_genesis_state,
+        interop_secret_keys,
+    )
+    from lodestar_tpu.validator import SlashingProtection, Validator, ValidatorStore
+
+    params.set_active_preset(args.preset)
+    p = params.active_preset()
+    far = 2**64 - 1
+    cc = minimal_chain_config().replace(
+        ALTAIR_FORK_EPOCH=far, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far
+    )
+    sks = interop_secret_keys(args.validators)
+    genesis = create_interop_genesis_state(
+        args.validators, p=p, genesis_fork_version=cc.GENESIS_FORK_VERSION
+    )
+
+    # manual clock: the dev loop drives slots itself from genesis
+    now = [0.0]
+    node = await BeaconNode.init(
+        anchor_state=genesis,
+        chain_config=cc,
+        opts=BeaconNodeOptions(
+            rest_enabled=args.rest_port != 0, rest_port=args.rest_port, manual_clock=True
+        ),
+        p=p,
+        time_fn=lambda: now[0],
+    )
+    cfg = create_beacon_config(cc, bytes(genesis.genesis_validators_root))
+    store = ValidatorStore(cfg, SlashingProtection(MemoryDbController()), sks, p)
+    validator = Validator(chain=node.chain, store=store, p=p)
+
+    for slot in range(1, args.slots + 1):
+        node.chain.fork_choice.on_tick(slot)
+        out = await validator.run_slot_duties(slot)
+        head = node.chain.get_head_state()
+        proposed = "block" if out["proposed"] is not None else "-    "
+        print(
+            f"slot {slot:3d}: {proposed} atts={len(out['attestations']):3d} "
+            f"justified={head.current_justified_checkpoint.epoch} "
+            f"finalized={head.finalized_checkpoint.epoch}"
+        )
+        if args.slot_time:
+            await asyncio.sleep(args.slot_time)
+    head = node.chain.get_head_state()
+    ok = head.slot == args.slots
+    print(f"dev chain done: head slot {head.slot}, finalized epoch {head.finalized_checkpoint.epoch}")
+    await node.close()
+    return 0 if ok else 1
+
+
+async def _run_beacon(args) -> int:
+    from lodestar_tpu import params
+    from lodestar_tpu.node import BeaconNode, BeaconNodeOptions
+    from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
+
+    params.set_active_preset(args.preset)
+    p = params.active_preset()
+    genesis = create_interop_genesis_state(args.genesis_validators, p=p)
+    node = await BeaconNode.init(
+        anchor_state=genesis,
+        opts=BeaconNodeOptions(
+            db_path=(args.db + "/wal.log") if args.db else None,
+            rest_port=args.rest_port,
+            metrics_enabled=args.metrics_port != 0,
+            metrics_port=args.metrics_port,
+        ),
+        p=p,
+    )
+    print(f"beacon node running; REST on :{node.rest_server.port}  (ctrl-c to stop)")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await node.close()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "dev":
+        return asyncio.run(_run_dev(args))
+    if args.cmd == "beacon":
+        return asyncio.run(_run_beacon(args))
+    if args.cmd == "bench":
+        import os
+
+        # bench.py is a repo-root script; make it importable from anywhere
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        import bench
+
+        bench.main()
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,8 +47,13 @@ from lodestar_tpu.ops import tower as tw
 __all__ = [
     "COEFF_BITS",
     "prepare_sets",
+    "build_device_inputs",
     "device_batch_verify",
+    "device_batch_verify_many",
+    "device_batch_verify_sharded",
+    "make_synthetic_sets",
     "verify_signature_sets_device",
+    "verify_signature_sets_sharded",
 ]
 
 COEFF_BITS = 64  # blinding scalar width, matches blst's 64-bit rand coeffs
@@ -169,6 +174,26 @@ def device_batch_verify(pk, h, sig, coeff_bits, mask) -> jax.Array:
     Returns a scalar bool array.
     """
     return _device_batch_verify_impl(
+        pk[0], pk[1], h[0], h[1], sig[0], sig[1],
+        jnp.asarray(coeff_bits), jnp.asarray(mask),
+    )
+
+
+_device_batch_verify_many_impl = jax.jit(jax.vmap(_device_batch_verify_impl))
+
+
+def device_batch_verify_many(pk, h, sig, coeff_bits, mask) -> jax.Array:
+    """J independent RLC jobs verified in ONE device launch (leading axis
+    J on every input). Each job keeps its own blinding, fold, final
+    exponentiation and verdict — the device translation of the
+    reference's \"one job per worker core\" concurrency
+    (`multithread/index.ts:348`): the program is latency-bound, so
+    stacking jobs widens every op's batch and multiplies throughput at
+    ~constant wall time.
+
+    Returns (J,) bool verdicts.
+    """
+    return _device_batch_verify_many_impl(
         pk[0], pk[1], h[0], h[1], sig[0], sig[1],
         jnp.asarray(coeff_bits), jnp.asarray(mask),
     )

@@ -1,0 +1,141 @@
+"""BeaconNode composition root (reference `beacon-node/src/node/nodejs.ts:141`).
+
+`BeaconNode.init` wires the full runtime in the reference's order: db →
+metrics (+ scrape server) → chain (BLS verifier pool + fork choice +
+pools) → clock → REST API → status notifier. `close()` runs the abort
+cascade in reverse (`nodejs.ts:146-152`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from lodestar_tpu.api import BeaconApiImpl, BeaconRestApiServer
+from lodestar_tpu.chain.bls import BlsSingleThreadVerifier, IBlsVerifier
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.clock import Clock
+from lodestar_tpu.db import DbController, FileDbController, MemoryDbController
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.metrics import BeaconMetrics, MetricsServer, create_metrics
+from lodestar_tpu.params import BeaconPreset, active_preset
+
+__all__ = ["BeaconNode", "BeaconNodeOptions"]
+
+
+class BeaconNodeOptions:
+    def __init__(
+        self,
+        *,
+        db_path: str | None = None,
+        rest_port: int = 9596,
+        rest_enabled: bool = True,
+        metrics_port: int = 8008,
+        metrics_enabled: bool = False,
+        use_device_verifier: bool = False,
+        manual_clock: bool = False,
+    ):
+        self.db_path = db_path
+        self.rest_port = rest_port
+        self.rest_enabled = rest_enabled
+        self.metrics_port = metrics_port
+        self.metrics_enabled = metrics_enabled
+        self.use_device_verifier = use_device_verifier
+        self.manual_clock = manual_clock
+
+
+class BeaconNode:
+    def __init__(self, *, chain, clock, db, metrics, rest_server, metrics_server, bls):
+        self.chain = chain
+        self.clock = clock
+        self.db = db
+        self.metrics = metrics
+        self.rest_server = rest_server
+        self.metrics_server = metrics_server
+        self.bls = bls
+        self.log = get_logger(name="lodestar.node")
+
+    @classmethod
+    async def init(
+        cls,
+        *,
+        anchor_state,
+        chain_config=None,
+        opts: BeaconNodeOptions | None = None,
+        p: BeaconPreset | None = None,
+        time_fn=None,
+    ) -> "BeaconNode":
+        opts = opts or BeaconNodeOptions()
+        p = p or active_preset()
+
+        # 1. db
+        db: DbController
+        if opts.db_path:
+            db = FileDbController(opts.db_path)
+        else:
+            db = MemoryDbController()
+
+        # 2. metrics
+        metrics: BeaconMetrics = create_metrics()
+        metrics_server = None
+        if opts.metrics_enabled:
+            metrics_server = MetricsServer(metrics, port=opts.metrics_port)
+            metrics_server.start()
+
+        # 3. bls verifier
+        bls: IBlsVerifier
+        if opts.use_device_verifier:
+            from lodestar_tpu.chain.bls import BlsDeviceVerifierPool
+
+            bls = BlsDeviceVerifierPool()
+        else:
+            bls = BlsSingleThreadVerifier()
+
+        # 4. clock from genesis time
+        clock_kwargs = dict(
+            genesis_time=anchor_state.genesis_time,
+            seconds_per_slot=chain_config.SECONDS_PER_SLOT if chain_config else 12,
+            slots_per_epoch=p.SLOTS_PER_EPOCH,
+        )
+        if time_fn is not None:
+            clock_kwargs["time_fn"] = time_fn
+        clock = Clock(**clock_kwargs)
+
+        # 5. chain
+        chain = BeaconChain(
+            anchor_state=anchor_state,
+            bls_verifier=bls,
+            db=db,
+            p=p,
+            cfg=chain_config,
+            current_slot=max(clock.current_slot, anchor_state.slot),
+            metrics=metrics,
+        )
+        clock.on_slot(chain.on_slot)
+        if not opts.manual_clock:
+            clock.start()
+
+        # 6. REST API
+        rest_server = None
+        if opts.rest_enabled:
+            rest_server = BeaconRestApiServer(BeaconApiImpl(chain), port=opts.rest_port)
+            rest_server.start()
+
+        node = cls(
+            chain=chain, clock=clock, db=db, metrics=metrics,
+            rest_server=rest_server, metrics_server=metrics_server, bls=bls,
+        )
+        node.log.info(
+            f"beacon node up: slot {clock.current_slot}, "
+            f"rest {'on :' + str(rest_server.port) if rest_server else 'off'}"
+        )
+        return node
+
+    async def close(self) -> None:
+        """Abort cascade, reverse init order (nodejs.ts:146-152)."""
+        if self.rest_server is not None:
+            self.rest_server.stop()
+        await self.clock.stop()
+        await self.bls.close()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        self.db.close()

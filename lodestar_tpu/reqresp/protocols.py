@@ -1,0 +1,63 @@
+"""Beacon req/resp protocol table (reference
+`beacon-node/src/network/reqresp/protocols.ts`): protocol ids, request/
+response SSZ types, chunk limits. Types resolve lazily from the registry
+so the table works under any preset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from lodestar_tpu import ssz
+
+__all__ = ["Protocol", "BEACON_PROTOCOLS", "protocol_by_id"]
+
+
+@dataclass(frozen=True)
+class Protocol:
+    protocol_id: str  # /eth2/beacon_chain/req/<name>/<version>/ssz_snappy
+    request_type: Callable[[], object] | None  # () -> SSZType or None (no body)
+    response_type: Callable[[], object]
+    max_response_chunks: int
+
+
+def _t():
+    from lodestar_tpu.types import ssz_types
+
+    return ssz_types()
+
+
+def _pid(name: str, version: int = 1) -> str:
+    return f"/eth2/beacon_chain/req/{name}/{version}/ssz_snappy"
+
+
+BEACON_PROTOCOLS: dict[str, Protocol] = {
+    p.protocol_id: p
+    for p in [
+        Protocol(_pid("status"), lambda: _t().Status, lambda: _t().Status, 1),
+        Protocol(_pid("goodbye"), lambda: ssz.uint64, lambda: ssz.uint64, 1),
+        Protocol(_pid("ping"), lambda: ssz.uint64, lambda: ssz.uint64, 1),
+        Protocol(_pid("metadata"), None, lambda: _t().phase0.Metadata, 1),
+        Protocol(_pid("metadata", 2), None, lambda: _t().altair.Metadata, 1),
+        Protocol(
+            _pid("beacon_blocks_by_range"),
+            lambda: _t().BeaconBlocksByRangeRequest,
+            lambda: _t().phase0.SignedBeaconBlock,
+            1024,
+        ),
+        Protocol(
+            _pid("beacon_blocks_by_root"),
+            lambda: ssz.List(ssz.Bytes32, 1024),
+            lambda: _t().phase0.SignedBeaconBlock,
+            1024,
+        ),
+    ]
+}
+
+
+def protocol_by_id(protocol_id: str) -> Protocol:
+    p = BEACON_PROTOCOLS.get(protocol_id)
+    if p is None:
+        raise KeyError(f"unknown protocol {protocol_id}")
+    return p

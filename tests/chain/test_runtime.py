@@ -176,3 +176,21 @@ def test_seen_attesters():
     assert not seen.is_known(1, 42)
     with pytest.raises(ValueError):
         seen.add(1, 7)
+
+
+def test_monitoring_service_collects_and_pushes():
+    import asyncio
+
+    from lodestar_tpu.metrics.monitoring import MonitoringService
+
+    sent = []
+    svc = MonitoringService(endpoint="http://x", interval_sec=0.01, send_fn=sent.append)
+
+    async def go():
+        svc.start()
+        await asyncio.sleep(0.05)
+        await svc.stop()
+
+    asyncio.run(go())
+    assert sent and sent[0][0]["process"] == "beaconnode"
+    assert sent[0][0]["client_name"] == "lodestar-tpu"

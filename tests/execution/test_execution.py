@@ -1,0 +1,134 @@
+"""Execution engine mock + JWT client framing + eth1 voting."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.execution import (
+    Eth1ForBlockProductionDisabled,
+    Eth1MemoryProvider,
+    ExecutePayloadStatus,
+    ExecutionEngineHttp,
+    ExecutionEngineMock,
+    PayloadAttributes,
+)
+from lodestar_tpu.execution.eth1 import Eth1Block
+from lodestar_tpu.types import ssz_types
+
+
+@pytest.fixture(autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def _payload(t, block_hash, parent_hash, number=1):
+    pl = t.bellatrix.ExecutionPayload.default()
+    pl.block_hash = block_hash
+    pl.parent_hash = parent_hash
+    pl.block_number = number
+    return pl
+
+
+def test_mock_engine_payload_lifecycle():
+    async def go():
+        t = ssz_types()
+        el = ExecutionEngineMock()
+        # new payload on known parent -> VALID
+        p1 = _payload(t, b"\x01" * 32, b"\x00" * 32)
+        status, lvh = await el.notify_new_payload(p1)
+        assert status is ExecutePayloadStatus.VALID and lvh == b"\x01" * 32
+        # unknown parent -> SYNCING
+        orphan = _payload(t, b"\x09" * 32, b"\x77" * 32)
+        status, _ = await el.notify_new_payload(orphan)
+        assert status is ExecutePayloadStatus.SYNCING
+        # scripted invalid -> INVALID with parent as latest valid hash
+        el.invalid_hashes.add(b"\x02" * 32)
+        bad = _payload(t, b"\x02" * 32, b"\x01" * 32, 2)
+        status, lvh = await el.notify_new_payload(bad)
+        assert status is ExecutePayloadStatus.INVALID and lvh == b"\x01" * 32
+        # fcU + payload building
+        pid = await el.notify_forkchoice_update(
+            b"\x01" * 32, b"\x01" * 32, b"\x00" * 32,
+            PayloadAttributes(timestamp=12, prev_randao=b"\x05" * 32, suggested_fee_recipient=b"\x00" * 20),
+        )
+        assert pid is not None
+        built = await el.get_payload(pid)
+        assert built.block_number == 2 and built.parent_hash == b"\x01" * 32
+
+    asyncio.run(go())
+
+
+def test_http_engine_jwt_and_rpc_framing(monkeypatch):
+    async def go():
+        t = ssz_types()
+        secret = b"\x42" * 32
+        eng = ExecutionEngineHttp("http://localhost:0", secret)
+        sent = {}
+
+        def fake_post(body):
+            sent["body"] = body
+            tok = eng._jwt_token()
+            # HS256 over header.claims verifies with the shared secret
+            h, c, s = tok.split(".")
+            sig = base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+            assert hmac.new(secret, f"{h}.{c}".encode(), hashlib.sha256).digest() == sig
+            return {"jsonrpc": "2.0", "id": 1, "result": {"status": "VALID", "latestValidHash": "0x" + "ab" * 32}}
+
+        monkeypatch.setattr(eng, "_post", fake_post)
+        status, lvh = await eng.notify_new_payload(t.bellatrix.ExecutionPayload.default())
+        assert status is ExecutePayloadStatus.VALID and lvh == b"\xab" * 32
+        assert sent["body"]["method"] == "engine_newPayloadV1"
+        assert sent["body"]["params"][0]["block_number"] == "0"
+
+    asyncio.run(go())
+
+
+def test_eth1_voting():
+    t = ssz_types()
+    state = t.phase0.BeaconState.default()
+    state.eth1_data.deposit_count = 5
+
+    state.eth1_deposit_index = 5
+    provider = Eth1MemoryProvider(follow_distance_sec=100)
+    provider.feed_block(Eth1Block(1, 1000, b"\x01" * 32, b"\x0a" * 32, 5))
+    provider.feed_block(Eth1Block(2, 1100, b"\x02" * 32, b"\x0b" * 32, 6))
+    provider.feed_block(Eth1Block(3, 1190, b"\x03" * 32, b"\x0c" * 32, 7))
+
+    # no deposit events fed: the provider must NOT vote beyond count 5
+    # (blocks would wedge on the STF deposit-count check otherwise)
+    data, deposits = provider.get_eth1_data_and_deposits(state, current_time=1200)
+    assert bytes(data.block_hash) == b"\x01" * 32 and deposits == []
+
+    # with deposit 5 fed, count 6 becomes servable: latest candidate in
+    # window = block 2, and its pending deposit is returned for packing
+    dep5 = t.Deposit.default()
+    provider.feed_deposit(5, dep5)
+    data, deposits = provider.get_eth1_data_and_deposits(state, current_time=1200)
+    assert bytes(data.block_hash) == b"\x02" * 32
+    assert deposits == [dep5]
+
+    # an existing majority vote for block 1 wins
+    v = t.Eth1Data.default()
+    v.block_hash = b"\x01" * 32
+    v.deposit_count = 5
+    state.eth1_data_votes = [v, v]
+    data, _ = provider.get_eth1_data_and_deposits(state, current_time=1200)
+    assert bytes(data.block_hash) == b"\x01" * 32
+
+    # deposit-count monotonicity enforced on feed
+    with pytest.raises(ValueError):
+        provider.feed_block(Eth1Block(4, 1300, b"\x04" * 32, b"\x0d" * 32, 2))
+
+    # disabled provider echoes the state's data
+    d, deps = Eth1ForBlockProductionDisabled().get_eth1_data_and_deposits(state)
+    assert d is state.eth1_data and deps == []

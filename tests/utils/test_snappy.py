@@ -1,0 +1,70 @@
+"""Snappy block + frame codecs: roundtrips, known vectors, corruption."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from lodestar_tpu.utils.snappy import (
+    SnappyError,
+    compress,
+    crc32c,
+    decompress,
+    frame_compress,
+    frame_decompress,
+)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 known answers
+    assert crc32c(b"") == 0x00000000
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_block_roundtrip_various():
+    rng = random.Random(0)
+    cases = [
+        b"",
+        b"a",
+        b"abcabcabcabcabcabcabc" * 10,  # repetitive -> real copies
+        bytes(rng.randbytes(100)),
+        bytes(rng.randbytes(70000)),  # incompressible
+        (b"0123456789abcdef" * 5000),  # long repetitive
+    ]
+    for data in cases:
+        assert decompress(compress(data)) == data
+
+
+def test_compression_actually_compresses():
+    data = b"the quick brown fox " * 500
+    assert len(compress(data)) < len(data) // 3
+
+
+def test_decompress_handles_all_copy_forms():
+    # hand-built: literal "abcd", copy-1 (off 4 len 4), copy-2 (off 4 len 8)
+    payload = bytes([len(b"abcd") - 1 << 2]) + b"abcd"
+    copy1 = bytes([0b01 | ((4 - 4) << 2) | ((4 >> 8) << 5), 4])
+    copy2 = bytes([0b10 | ((8 - 1) << 2)]) + (4).to_bytes(2, "little")
+    blob = bytes([16]) + payload + copy1 + copy2
+    assert decompress(blob) == b"abcd" * 4
+
+
+def test_corruption_detected():
+    data = compress(b"hello world" * 100)
+    with pytest.raises(SnappyError):
+        decompress(data[:-3])
+    with pytest.raises(SnappyError):
+        decompress(b"\x05\x0f")  # truncated literal
+
+
+def test_frame_roundtrip_and_checksum():
+    rng = random.Random(1)
+    for data in (b"", b"tiny", rng.randbytes(200_000)):
+        framed = frame_compress(data)
+        assert frame_decompress(framed) == data
+    framed = bytearray(frame_compress(b"checksummed data" * 100))
+    framed[-1] ^= 0xFF  # corrupt the last payload byte
+    with pytest.raises(SnappyError):
+        frame_decompress(bytes(framed))

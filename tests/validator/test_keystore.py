@@ -1,0 +1,35 @@
+"""EIP-2335 keystores: roundtrip both KDFs, wrong password, tamper."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu.validator.keystore import KeystoreError, decrypt_keystore, encrypt_keystore
+
+SECRET = bytes.fromhex("000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f")
+PUB = b"\x12" * 48
+
+
+@pytest.mark.parametrize("kdf", ["pbkdf2", "scrypt"])
+def test_roundtrip(kdf):
+    ks = encrypt_keystore(SECRET, "correct horse battery staple", PUB, kdf=kdf, path="m/12381/3600/0/0/0")
+    assert ks["version"] == 4
+    assert ks["pubkey"] == PUB.hex()
+    out = decrypt_keystore(ks, "correct horse battery staple")
+    assert out == SECRET
+
+
+def test_wrong_password_and_tamper():
+    ks = encrypt_keystore(SECRET, "password", PUB)
+    with pytest.raises(KeystoreError, match="checksum"):
+        decrypt_keystore(ks, "wrong")
+    ks2 = encrypt_keystore(SECRET, "password", PUB)
+    ks2["crypto"]["cipher"]["message"] = "00" * 32
+    with pytest.raises(KeystoreError):
+        decrypt_keystore(ks2, "password")
+
+
+def test_password_nfkd_and_control_stripping():
+    # EIP-2335: NFKD normalization + C0/C1 control char stripping
+    ks = encrypt_keystore(SECRET, "pa\x07ss", PUB)
+    assert decrypt_keystore(ks, "pass") == SECRET
