@@ -114,6 +114,8 @@ class BeaconChain:
         self.attestation_pool = AttestationPool()
         self.aggregated_attestation_pool = AggregatedAttestationPool()
         self.op_pool = OpPool()
+        # optional eth1 provider for block production (execution.eth1)
+        self.eth1 = None
         self.seen_attesters = SeenAttesters()
 
         # anchor: latest block header of the anchor state defines the root

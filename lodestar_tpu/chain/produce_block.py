@@ -67,7 +67,10 @@ def produce_block(
     work = pre_state.copy()
     ctx = process_slots(work, slot, p, chain.cfg) if slot > work.slot else EpochContext(work, p)
 
-    block = t.phase0.BeaconBlock.default()
+    from lodestar_tpu.state_transition.block import block_types_for
+
+    block_type, _ = block_types_for(work, p)
+    block = block_type.default()
     block.slot = slot
     block.proposer_index = ctx.get_beacon_proposer(slot)
     block.parent_root = head_root
@@ -75,7 +78,19 @@ def produce_block(
     body = block.body
     body.randao_reveal = randao_reveal
     body.graffiti = (graffiti or b"").ljust(32, b"\x00")[:32]
-    body.eth1_data = work.eth1_data  # eth1 voting lands with the eth1 tracker
+    eth1 = getattr(chain, "eth1", None)
+    if eth1 is not None:
+        body.eth1_data, deposits = eth1.get_eth1_data_and_deposits(work)
+        body.deposits = deposits[: p.MAX_DEPOSITS]
+    else:
+        body.eth1_data = work.eth1_data
+
+    from lodestar_tpu.state_transition.block import fork_of
+
+    if fork_of(work) != "phase0":
+        # empty sync aggregate must carry the G2 infinity signature (the
+        # eth2 convention eth_fast_aggregate_verify accepts for no bits)
+        body.sync_aggregate.sync_committee_signature = bytes([0xC0]) + bytes(95)
 
     att_slashings, prop_slashings, exits = chain.op_pool.get_slashings_and_exits(work, p)
     body.proposer_slashings = prop_slashings

@@ -1,0 +1,214 @@
+"""KZG commitments for EIP-4844 blobs (replaces the reference's c-kzg
+C binding, `beacon-node/src/util/kzg.ts` + `chain/validation/blobsSidecar.ts`).
+
+Written from the public polynomial-commitments spec over this repo's own
+pairing stack: commitments are MSMs over the Lagrange trusted setup
+(device `ops.msm` for the 4096-point blob commitment), proof verification
+is two pairings through the byte-exact CPU oracle.
+
+`trusted_setup.bin` is the public KZG ceremony output (4096 G1 Lagrange
+points in bit-reversed order + 65 G2 monomial points; format
+header u32be(4096) u32be(96) then compressed points — c-kzg-4844 issue #3,
+same file the reference ships at `beacon-node/trusted_setup.bin`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from functools import lru_cache
+
+import numpy as np
+
+from . import bls  # noqa: F401  (package marker)
+from .bls import curve as C
+from .bls.fields import R
+from .bls.pairing import pairings_are_one
+from .bls.serdes import g1_from_bytes, g1_to_bytes, g2_from_bytes
+
+__all__ = [
+    "load_trusted_setup",
+    "blob_to_kzg_commitment",
+    "verify_kzg_proof",
+    "verify_blob_kzg_proof",
+    "compute_roots_of_unity",
+    "KzgError",
+    "FIELD_ELEMENTS_PER_BLOB_MAINNET",
+]
+
+FIELD_ELEMENTS_PER_BLOB_MAINNET = 4096
+_SETUP_PATH = os.path.join(os.path.dirname(__file__), "trusted_setup.bin")
+_GENERATOR = 7  # Fr multiplicative generator (c-kzg GENERATOR)
+BYTES_PER_FIELD_ELEMENT = 32
+FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVH"
+
+
+class KzgError(Exception):
+    pass
+
+
+@lru_cache(maxsize=1)
+def load_trusted_setup(path: str = _SETUP_PATH):
+    """-> (g1_lagrange: list of oracle affine points (bit-reversed order),
+    g2_monomial: list of oracle G2 affine points)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    n_g1 = int.from_bytes(data[0:4], "big")
+    g2_bytes = int.from_bytes(data[4:8], "big")
+    assert g2_bytes == 96
+    pos = 8
+    g1 = []
+    for _ in range(n_g1):
+        pt = g1_from_bytes(data[pos : pos + 48])
+        if pt is None:
+            raise KzgError("invalid G1 point in trusted setup")
+        g1.append(pt)
+        pos += 48
+    g2 = []
+    while pos + 96 <= len(data):
+        pt = g2_from_bytes(data[pos : pos + 96])
+        if pt is None:
+            raise KzgError("invalid G2 point in trusted setup")
+        g2.append(pt)
+        pos += 96
+    return g1, g2
+
+
+# --- field / domain helpers --------------------------------------------------
+
+
+def _bit_reverse(n: int, order: int) -> int:
+    bits = order.bit_length() - 1
+    out = 0
+    for i in range(bits):
+        out = (out << 1) | ((n >> i) & 1)
+    return out
+
+
+@lru_cache(maxsize=4)
+def compute_roots_of_unity(order: int, bit_reversed: bool = True) -> tuple[int, ...]:
+    """Primitive `order`-th roots of unity in Fr, in the bit-reversed
+    permutation c-kzg uses for the Lagrange setup."""
+    assert (R - 1) % order == 0
+    omega = pow(_GENERATOR, (R - 1) // order, R)
+    roots = [1] * order
+    for i in range(1, order):
+        roots[i] = roots[i - 1] * omega % R
+    if bit_reversed:
+        roots = [roots[_bit_reverse(i, order)] for i in range(order)]
+    return tuple(roots)
+
+
+def _blob_to_scalars(blob: bytes) -> list[int]:
+    if len(blob) % BYTES_PER_FIELD_ELEMENT:
+        raise KzgError("blob length not a multiple of 32")
+    out = []
+    for i in range(0, len(blob), BYTES_PER_FIELD_ELEMENT):
+        v = int.from_bytes(blob[i : i + 32], "big")
+        if v >= R:
+            raise KzgError("blob element out of field range")
+        out.append(v)
+    return out
+
+
+# --- commitments -------------------------------------------------------------
+
+
+def blob_to_kzg_commitment(blob: bytes, *, device: bool = True) -> bytes:
+    """MSM of the blob's field elements over the Lagrange setup
+    (device=True routes through ops.msm — the 4096-point G1 MSM is the
+    KZG hot loop BASELINE's plan earmarked for the device)."""
+    g1, _ = load_trusted_setup()
+    scalars = _blob_to_scalars(blob)
+    if len(scalars) != len(g1):
+        raise KzgError(f"blob has {len(scalars)} elements, setup {len(g1)}")
+    return _commit_msm(g1, scalars, device)
+
+
+def _commit_msm(g1, scalars, device: bool) -> bytes:
+    if device:
+        from lodestar_tpu.ops import curve as cv
+        from lodestar_tpu.ops import fp as fpo
+        from lodestar_tpu.ops import msm
+        from lodestar_tpu.ops import tower as tw  # noqa: F401
+
+        xs = np.asarray(fpo.to_mont(fpo.limbs_from_ints([p[0] for p in g1])))
+        ys = np.asarray(fpo.to_mont(fpo.limbs_from_ints([p[1] for p in g1])))
+        bits = msm.bits_msb(scalars, 255)
+        out = msm.msm_g1((xs, ys), bits)
+        aff = cv.jac_to_affine_batch(cv.F1, tuple(np.asarray(c)[None] for c in out))
+        z_zero = bool(np.all(np.asarray(out[2]) == 0))
+        if z_zero:
+            return g1_to_bytes(None)
+        x = fpo.int_from_limbs(np.asarray(fpo.from_mont(np.asarray(aff[0])[0])))
+        y = fpo.int_from_limbs(np.asarray(fpo.from_mont(np.asarray(aff[1])[0])))
+        return g1_to_bytes((x, y))
+    acc = None
+    for pt, s in zip(g1, scalars):
+        if s:
+            acc = C.g1_add(acc, C.g1_mul(pt, s))
+    return g1_to_bytes(acc)
+
+
+# --- verification ------------------------------------------------------------
+
+
+def verify_kzg_proof(commitment: bytes, z: int, y: int, proof: bytes) -> bool:
+    """Pairing check e(P - [y]G1, -G2) * e(proof, [tau]G2 - [z]G2) == 1."""
+    _, g2 = load_trusted_setup()
+    c_pt = g1_from_bytes(commitment)
+    proof_pt = g1_from_bytes(proof)
+    if commitment != bytes([0xC0]) + bytes(47) and c_pt is None:
+        return False
+    if proof != bytes([0xC0]) + bytes(47) and proof_pt is None:
+        return False
+
+    # X - [z] in G2: tau_g2 - z*g2_gen
+    tau_g2 = g2[1]
+    z_g2 = C.g2_mul(C.G2_GEN, z % R) if z % R else None
+    x_minus_z = C.g2_add(tau_g2, C.g2_neg(z_g2) if z_g2 else None)
+    # P - [y] in G1
+    y_g1 = C.g1_mul(C.G1_GEN, y % R) if y % R else None
+    p_minus_y = C.g1_add(c_pt, C.g1_neg(y_g1) if y_g1 else None)
+
+    return pairings_are_one(
+        [
+            (p_minus_y, C.g2_neg(C.G2_GEN)),
+            (proof_pt, x_minus_z),
+        ]
+    )
+
+
+def _evaluate_blob_at(blob_scalars: list[int], z: int) -> int:
+    """Barycentric evaluation of the (bit-reversed) evaluation-form
+    polynomial at z (spec evaluate_polynomial_in_evaluation_form)."""
+    n = len(blob_scalars)
+    roots = compute_roots_of_unity(n)
+    z %= R
+    for i, w in enumerate(roots):
+        if z == w:
+            return blob_scalars[i]
+    # p(z) = (z^n - 1)/n * sum_i p_i * w_i / (z - w_i)
+    total = 0
+    for p_i, w in zip(blob_scalars, roots):
+        total = (total + p_i * w % R * pow((z - w) % R, R - 2, R)) % R
+    zn = (pow(z, n, R) - 1) % R
+    return total * zn % R * pow(n, R - 2, R) % R
+
+
+def _compute_challenge(blob: bytes, commitment: bytes) -> int:
+    """Fiat-Shamir challenge (spec compute_challenge)."""
+    n = len(blob) // BYTES_PER_FIELD_ELEMENT
+    # spec compute_challenge: domain || uint128be(FIELD_ELEMENTS_PER_BLOB)
+    # || blob || commitment, hashed to a field element big-endian
+    data = FIAT_SHAMIR_PROTOCOL_DOMAIN + n.to_bytes(16, "big") + blob + commitment
+    return int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+
+
+def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes) -> bool:
+    """Spec verify_blob_kzg_proof: evaluate at the Fiat-Shamir challenge
+    and verify the opening."""
+    scalars = _blob_to_scalars(blob)
+    z = _compute_challenge(blob, commitment)
+    y = _evaluate_blob_at(scalars, z)
+    return verify_kzg_proof(commitment, z, y, proof)

@@ -82,20 +82,43 @@ def process_slot(state, p: BeaconPreset | None = None) -> None:
     state.block_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_block_root
 
 
-def process_slots(state, slot: int, p: BeaconPreset | None = None, cfg=None) -> EpochContext:
-    """Advance state to `slot`, running epoch processing at boundaries.
-    Returns the EpochContext valid for the final slot's epoch."""
+def process_slots(state, slot: int, p: BeaconPreset | None = None, cfg=None):
+    """Advance state to `slot`: epoch processing at boundaries (fork-
+    dispatched per the state's container fork) and scheduled fork
+    upgrades at their activation epochs. Upgrades swap the container
+    in place, so every existing reference to `state` observes the new
+    fork. Returns the EpochContext for the final slot's epoch."""
+    from .block import fork_of
+
     p = p or active_preset()
     if slot <= state.slot:
         raise StateTransitionError(f"cannot advance to past slot {slot} <= {state.slot}")
-    ctx: EpochContext | None = None
     while state.slot < slot:
         process_slot(state, p)
         if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
-            process_epoch(state, EpochContext(state, p), cfg)
-            ctx = None  # shufflings/proposers change across the boundary
+            if fork_of(state) == "phase0":
+                process_epoch(state, EpochContext(state, p), cfg)
+            else:
+                from .altair import process_epoch_altair
+
+                process_epoch_altair(state, EpochContext(state, p), cfg)
         state.slot += 1
-    return ctx or EpochContext(state, p)
+        # scheduled upgrade at the first slot of the activation epoch
+        if (
+            cfg is not None
+            and state.slot % p.SLOTS_PER_EPOCH == 0
+            and fork_of(state) == "phase0"
+            and getattr(cfg, "ALTAIR_FORK_EPOCH", 2**64 - 1) == state.slot // p.SLOTS_PER_EPOCH
+        ):
+            from .altair import upgrade_to_altair
+
+            upgraded = upgrade_to_altair(state, cfg, p)
+            # mutate-in-place semantics: swap the container contents
+            state.__dict__.clear()
+            object.__setattr__(state, "_type", upgraded.type)
+            for name in upgraded.type._field_names:
+                setattr(state, name, getattr(upgraded, name))
+    return EpochContext(state, p)
 
 
 def state_transition(
@@ -120,10 +143,12 @@ def state_transition(
         from lodestar_tpu.crypto.bls import api as bls
         from lodestar_tpu.params import DOMAIN_BEACON_PROPOSER
 
-        t = ssz_types(p)
+        from .block import block_types_for
+
         proposer = post.validators[block.proposer_index]
         domain = get_domain(post, DOMAIN_BEACON_PROPOSER)
-        root = compute_signing_root(t.phase0.BeaconBlock, block, domain)
+        block_type, _ = block_types_for(post, p)
+        root = compute_signing_root(block_type, block, domain)
         if not bls.verify(bytes(proposer.pubkey), root, bytes(signed_block.signature)):
             raise StateTransitionError("invalid block proposer signature")
 

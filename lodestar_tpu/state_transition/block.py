@@ -69,6 +69,25 @@ def _t(p: BeaconPreset):
     return ssz_types(p)
 
 
+_FORKS = ("phase0", "altair", "bellatrix", "capella", "deneb")
+
+
+def fork_of(state) -> str:
+    """Fork name from the state container (BeaconStateAltair -> altair)."""
+    name = state.type.name.lower()
+    for fork in _FORKS:
+        if name.endswith(fork):
+            return fork
+    return "phase0"
+
+
+def block_types_for(state, p: BeaconPreset):
+    """(BeaconBlock, BeaconBlockBody) container types for the state's fork."""
+    t = _t(p)
+    ns = getattr(t, fork_of(state))
+    return ns.BeaconBlock, ns.BeaconBlockBody
+
+
 def process_block_header(state, block, ctx: EpochContext) -> None:
     p = ctx.p
     t = _t(p)
@@ -86,7 +105,7 @@ def process_block_header(state, block, ctx: EpochContext) -> None:
     header.proposer_index = block.proposer_index
     header.parent_root = bytes(block.parent_root)
     header.state_root = b"\x00" * 32  # overwritten at the next slot processing
-    header.body_root = t.phase0.BeaconBlockBody.hash_tree_root(block.body)
+    header.body_root = block_types_for(state, p)[1].hash_tree_root(block.body)
     state.latest_block_header = header
 
     proposer = state.validators[block.proposer_index]
@@ -361,12 +380,19 @@ def process_operations(state, body, ctx: EpochContext, verify_signatures: bool =
         raise BlockProcessError(
             f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
         )
+    altair_plus = fork_of(state) != "phase0"
     for ps in body.proposer_slashings:
         process_proposer_slashing(state, ps, ctx, verify_signatures, cfg)
     for als in body.attester_slashings:
         process_attester_slashing(state, als, ctx, verify_signatures, cfg)
-    for att in body.attestations:
-        process_attestation(state, att, ctx, verify_signatures)
+    if altair_plus:
+        from .altair import process_attestation_altair
+
+        for att in body.attestations:
+            process_attestation_altair(state, att, ctx, verify_signatures)
+    else:
+        for att in body.attestations:
+            process_attestation(state, att, ctx, verify_signatures)
     for dep in body.deposits:
         process_deposit(state, dep, ctx, cfg)
     for ex in body.voluntary_exits:
@@ -374,8 +400,12 @@ def process_operations(state, body, ctx: EpochContext, verify_signatures: bool =
 
 
 def process_block(state, block, ctx: EpochContext, verify_signatures: bool = True, cfg=None) -> None:
-    """Spec process_block, phase0 (reference `block/index.ts`)."""
+    """Spec process_block, fork-dispatched (reference `block/index.ts`)."""
     process_block_header(state, block, ctx)
     process_randao(state, block.body, ctx, verify_signatures)
     process_eth1_data(state, block.body, ctx)
     process_operations(state, block.body, ctx, verify_signatures, cfg)
+    if fork_of(state) != "phase0":
+        from .altair import process_sync_aggregate
+
+        process_sync_aggregate(state, block.body.sync_aggregate, ctx, verify_signatures)

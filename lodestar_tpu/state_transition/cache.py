@@ -122,6 +122,20 @@ class EpochContext:
             raise ValueError("proposer cache only covers the current epoch")
         return self.proposers[slot % self.p.SLOTS_PER_EPOCH]
 
+    def pubkey_to_index(self, state) -> dict[bytes, int]:
+        """Registry pubkey -> validator index (reference EpochContext
+        pubkey2index, `cache/pubkeyCache.ts`). Built once per context and
+        extended for registry appends."""
+        cached = getattr(self, "_pubkey_to_index", None)
+        if cached is None or len(cached) < len(state.validators):
+            start = 0 if cached is None else len(cached)
+            if cached is None:
+                cached = {}
+                self._pubkey_to_index = cached
+            for i in range(start, len(state.validators)):
+                cached[bytes(state.validators[i].pubkey)] = i
+        return cached
+
     def get_attesting_indices(self, att_data, aggregation_bits) -> np.ndarray:
         committee = self.get_beacon_committee(att_data.slot, att_data.index)
         if len(aggregation_bits) != len(committee):

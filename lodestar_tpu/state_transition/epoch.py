@@ -59,7 +59,9 @@ class EpochProcess:
 
         eb = ctx.effective_balances
         self.effective_balances = eb
-        act = np.fromiter((v.activation_epoch for v in state.validators), dtype=np.int64)
+        act = np.fromiter(
+            (v.activation_epoch for v in state.validators), dtype=np.uint64
+        ).astype(np.float64)  # FAR_FUTURE_EPOCH overflows int64
         # exit/withdrawable epochs hold FAR_FUTURE_EPOCH (2^64-1): keep as
         # float64 for comparisons
         ext = np.fromiter((v.exit_epoch for v in state.validators), dtype=np.uint64).astype(np.float64)

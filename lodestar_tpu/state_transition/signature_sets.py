@@ -42,13 +42,15 @@ __all__ = [
 
 
 def block_proposer_signature_set(state, signed_block, ctx: EpochContext) -> SignatureSet:
-    t = ssz_types(ctx.p)
+    from .block import block_types_for
+
     block = signed_block.message
     proposer = state.validators[block.proposer_index]
     domain = get_domain(state, DOMAIN_BEACON_PROPOSER, compute_epoch_at_slot(block.slot, ctx.p))
+    block_type, _ = block_types_for(state, ctx.p)
     return SignatureSet(
         pubkey=bytes(proposer.pubkey),
-        message=compute_signing_root(t.phase0.BeaconBlock, block, domain),
+        message=compute_signing_root(block_type, block, domain),
         signature=bytes(signed_block.signature),
     )
 

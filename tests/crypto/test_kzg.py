@@ -1,0 +1,66 @@
+"""KZG: trusted-setup parse (Lagrange-sum identity), commitment MSM on
+device, proof verification via the pairing stack."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls.serdes import g1_to_bytes
+from lodestar_tpu.crypto.kzg import (
+    FIELD_ELEMENTS_PER_BLOB_MAINNET,
+    blob_to_kzg_commitment,
+    compute_roots_of_unity,
+    load_trusted_setup,
+    verify_blob_kzg_proof,
+    verify_kzg_proof,
+)
+
+G1_INF = bytes([0xC0]) + bytes(47)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    return load_trusted_setup()
+
+
+def test_setup_parses_and_lagrange_sum_is_generator(setup):
+    g1, g2 = setup
+    assert len(g1) == FIELD_ELEMENTS_PER_BLOB_MAINNET
+    assert len(g2) == 65
+    # sum of all Lagrange basis polys == 1, so the setup sums to [1]G
+    acc = None
+    for pt in g1:
+        acc = C.g1_add(acc, pt)
+    assert acc == C.G1_GEN
+    assert g2[0] == C.G2_GEN  # monomial setup starts at [tau^0]G2
+
+
+def test_roots_of_unity():
+    roots = compute_roots_of_unity(8, bit_reversed=False)
+    from lodestar_tpu.crypto.bls.fields import R
+
+    w = roots[1]
+    assert pow(w, 8, R) == 1 and pow(w, 4, R) != 1
+    brp = compute_roots_of_unity(8)
+    assert sorted(brp) == sorted(roots)
+    assert brp[1] == roots[4]  # bit-reversed position
+
+
+def test_constant_blob_commitment_and_proof(setup):
+    from lodestar_tpu.crypto.bls.fields import R
+
+    c = 0x1234567
+    blob = c.to_bytes(32, "big") * FIELD_ELEMENTS_PER_BLOB_MAINNET
+    commitment = blob_to_kzg_commitment(blob, device=True)
+    # constant polynomial: commitment == [c]G1
+    assert commitment == g1_to_bytes(C.g1_mul(C.G1_GEN, c))
+
+    # opening a constant poly anywhere: y == c, proof == infinity
+    assert verify_kzg_proof(commitment, z=99, y=c, proof=G1_INF)
+    assert not verify_kzg_proof(commitment, z=99, y=c + 1, proof=G1_INF)
+
+    # full blob verification with the Fiat-Shamir challenge
+    assert verify_blob_kzg_proof(blob, commitment, G1_INF)
+    wrong = g1_to_bytes(C.g1_mul(C.G1_GEN, c + 1))
+    assert not verify_blob_kzg_proof(blob, wrong, G1_INF)

@@ -1,0 +1,143 @@
+"""Gossip topics/ids/bus + peer scoring; capped by a two-node gossip
+exchange where a published block lands in the other node's chain."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.network import (
+    GossipBus,
+    GossipTopic,
+    PeerManager,
+    PeerScore,
+    compute_message_id,
+    topic_string,
+)
+from lodestar_tpu.network.peers import PeerAction, ScoreState
+from lodestar_tpu.utils.snappy import compress
+
+
+@pytest.fixture(autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def test_topic_naming():
+    t = GossipTopic("beacon_block", bytes.fromhex("deadbeef"))
+    assert str(t) == "/eth2/deadbeef/beacon_block/ssz_snappy"
+    assert topic_string("beacon_attestation_3", b"\x00" * 4) == "/eth2/00000000/beacon_attestation_3/ssz_snappy"
+
+
+def test_message_id_domains():
+    payload = b"hello gossip" * 10
+    valid = compute_message_id(compress(payload))
+    invalid = compute_message_id(b"\xff not snappy")
+    assert len(valid) == 20 and len(invalid) == 20
+    assert valid != invalid
+    # deterministic
+    assert compute_message_id(compress(payload)) == valid
+
+
+def test_bus_fanout_and_dedup():
+    async def go():
+        bus = GossipBus()
+        topic = GossipTopic("beacon_block", b"\x00" * 4)
+        got_a, got_b = [], []
+
+        async def on_a(data, frm):
+            got_a.append((data, frm))
+
+        async def on_b(data, frm):
+            got_b.append((data, frm))
+
+        bus.subscribe(topic, "a", on_a)
+        bus.subscribe(topic, "b", on_b)
+        n = await bus.publish(topic, b"block-bytes", from_peer="a")
+        assert n == 1  # only b; publisher doesn't hear itself
+        assert got_b == [(b"block-bytes", "a")] and got_a == []
+        # duplicate publish is deduped by message id
+        assert await bus.publish(topic, b"block-bytes", from_peer="b") == 0
+        assert bus.deduped == 1
+
+    asyncio.run(go())
+
+
+def test_two_nodes_gossip_block_import():
+    """node A proposes, publishes over the bus; node B imports from gossip."""
+    from lodestar_tpu.chain.bls import BlsVerifierMock
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.chain.validation import validate_gossip_block
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.state_transition.genesis import (
+        create_interop_genesis_state,
+        interop_secret_keys,
+    )
+    from lodestar_tpu.types import ssz_types
+
+    from ..chain.test_chain import _chain_of_blocks
+
+    async def go():
+        p = params.active_preset()
+        sks = interop_secret_keys(16)
+        genesis = create_interop_genesis_state(16, p=p)
+        t = ssz_types(p)
+
+        def mknode():
+            return BeaconChain(
+                anchor_state=genesis,
+                bls_verifier=BlsVerifierMock(True),
+                db=MemoryDbController(),
+                current_slot=1,
+            )
+
+        node_a, node_b = mknode(), mknode()
+        bus = GossipBus()
+        topic = GossipTopic("beacon_block", b"\x00" * 4)
+
+        async def b_on_block(data, frm):
+            signed = t.phase0.SignedBeaconBlock.deserialize(data)
+            validate_gossip_block(node_b, signed)
+            await node_b.process_block(signed)
+
+        bus.subscribe(topic, "b", b_on_block)
+
+        signed = _chain_of_blocks(genesis, sks, p, 1)[0]
+        await node_a.process_block(signed)
+        await bus.publish(topic, t.phase0.SignedBeaconBlock.serialize(signed), from_peer="a")
+        assert node_b.head_root == node_a.head_root
+
+    asyncio.run(go())
+
+
+def test_peer_scoring_decay_and_thresholds():
+    now = [0.0]
+    score = PeerScore(time_fn=lambda: now[0])
+    score.apply(PeerAction.MID_TOLERANCE_ERROR)
+    score.apply(PeerAction.MID_TOLERANCE_ERROR)
+    assert score.score == pytest.approx(-10.0)
+    assert score.state is ScoreState.HEALTHY
+    # halflife decay
+    now[0] += 600
+    assert score.score == pytest.approx(-5.0, rel=0.01)
+    score.apply(PeerAction.FATAL)
+    assert score.state is ScoreState.BANNED
+
+
+def test_peer_manager_prunes_worst():
+    now = [0.0]
+    pm = PeerManager(target_peers=2, time_fn=lambda: now[0])
+    for pid in ("p1", "p2", "p3"):
+        pm.on_connect(pid)
+    pm.report_peer("p2", PeerAction.MID_TOLERANCE_ERROR)
+    pm.heartbeat()
+    assert sorted(pm.connected_peers()) == ["p1", "p3"]
+    # banned peers are disconnected immediately
+    state = pm.report_peer("p1", PeerAction.FATAL)
+    assert state is ScoreState.BANNED
+    assert pm.connected_peers() == ["p3"]

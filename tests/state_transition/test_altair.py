@@ -1,0 +1,189 @@
+"""Altair: fork upgrade, sync aggregates, participation-flag epoch flow.
+
+Dev-style chain with 16 interop validators crossing ALTAIR_FORK_EPOCH=1,
+then altair blocks carrying real sync aggregates + attestations through
+justification."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu import params, ssz
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import (
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
+)
+from lodestar_tpu.state_transition import (
+    EpochContext,
+    compute_signing_root,
+    get_domain,
+    process_block,
+    process_slots,
+    state_transition,
+)
+from lodestar_tpu.state_transition.altair import (
+    get_attestation_participation_flag_indices,
+    upgrade_to_altair,
+)
+from lodestar_tpu.state_transition.block import fork_of
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.state_transition.util import get_block_root, get_block_root_at_slot
+from lodestar_tpu.types import ssz_types
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    far = 2**64 - 1
+    return minimal_chain_config().replace(
+        ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far
+    )
+
+
+@pytest.fixture(scope="module")
+def sks():
+    return interop_secret_keys(N)
+
+
+def test_scheduled_upgrade_in_process_slots(minimal_preset, cfg, sks):
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p, genesis_fork_version=cfg.GENESIS_FORK_VERSION)
+    state = genesis.copy()
+    assert fork_of(state) == "phase0"
+    process_slots(state, p.SLOTS_PER_EPOCH, p, cfg)
+    assert fork_of(state) == "altair"
+    assert bytes(state.fork.current_version) == cfg.ALTAIR_FORK_VERSION
+    assert bytes(state.fork.previous_version) == cfg.GENESIS_FORK_VERSION
+    assert len(state.previous_epoch_participation) == N
+    assert len(state.current_sync_committee.pubkeys) == p.SYNC_COMMITTEE_SIZE
+    assert state.inactivity_scores == [0] * N
+
+
+def _sign_sync_aggregate(state, sks_by_pubkey, p):
+    """SyncAggregate over the previous slot's block root by the full
+    current sync committee."""
+    t = ssz_types(p)
+    prev_slot = state.slot - 1
+    root = get_block_root_at_slot(state, prev_slot, p)
+    domain = get_domain(state, DOMAIN_SYNC_COMMITTEE, prev_slot // p.SLOTS_PER_EPOCH)
+    import hashlib
+
+    signing_root = hashlib.sha256(root + domain).digest()
+    agg = t.SyncAggregate.default()
+    bits, sigs = [], []
+    for pk in state.current_sync_committee.pubkeys:
+        sk = sks_by_pubkey.get(bytes(pk))
+        bits.append(sk is not None)
+        if sk is not None:
+            sigs.append(bls.sign(sk, signing_root))
+    agg.sync_committee_bits = bits
+    agg.sync_committee_signature = bls.aggregate_signatures(sigs)
+    return agg
+
+
+def _altair_block(state, slot, sks, p, cfg):
+    """Full valid signed altair block (randao + sync aggregate)."""
+    t = ssz_types(p)
+    sks_by_pubkey = {sk.to_pubkey(): sk for sk in sks}
+    work = state.copy()
+    ctx = process_slots(work, slot, p, cfg) if slot > work.slot else EpochContext(work, p)
+    proposer = ctx.get_beacon_proposer(slot)
+
+    block = t.altair.BeaconBlock.default()
+    block.slot = slot
+    block.proposer_index = proposer
+    block.parent_root = t.BeaconBlockHeader.hash_tree_root(work.latest_block_header)
+    epoch = slot // p.SLOTS_PER_EPOCH
+    block.body.randao_reveal = bls.sign(
+        sks[proposer], compute_signing_root(ssz.uint64, epoch, get_domain(work, DOMAIN_RANDAO))
+    )
+    block.body.eth1_data = work.eth1_data
+    block.body.sync_aggregate = _sign_sync_aggregate(work, sks_by_pubkey, p)
+
+    post = work.copy()
+    process_block(post, block, EpochContext(post, p), verify_signatures=False)
+    block.state_root = post.type.hash_tree_root(post)
+
+    signed = t.altair.SignedBeaconBlock.default()
+    signed.message = block
+    signed.signature = bls.sign(
+        sks[proposer],
+        compute_signing_root(t.altair.BeaconBlock, block, get_domain(work, DOMAIN_BEACON_PROPOSER)),
+    )
+    return signed
+
+
+def test_altair_block_with_sync_aggregate_full_verification(minimal_preset, cfg, sks):
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p, genesis_fork_version=cfg.GENESIS_FORK_VERSION)
+    state = genesis.copy()
+    process_slots(state, p.SLOTS_PER_EPOCH, p, cfg)  # upgrade at epoch 1
+    pre_balance = sum(state.balances)
+    signed = _altair_block(state, state.slot + 1, sks, p, cfg)
+    post = state_transition(state, signed, p, cfg)
+    assert fork_of(post) == "altair"
+    assert post.slot == p.SLOTS_PER_EPOCH + 1
+    # full sync committee participation nets positive rewards
+    assert sum(post.balances) > pre_balance
+
+    # a tampered sync aggregate is rejected
+    bad = signed.copy()
+    bits = list(bad.message.body.sync_aggregate.sync_committee_bits)
+    bits[0] = not bits[0]
+    bad.message.body.sync_aggregate.sync_committee_bits = bits
+    from lodestar_tpu.state_transition import BlockProcessError, StateTransitionError
+
+    with pytest.raises((BlockProcessError, StateTransitionError)):
+        state_transition(state, bad, p, cfg, verify_state_root=False,
+                         verify_proposer_signature=False)
+
+
+def test_altair_attestations_set_flags_and_justify(minimal_preset, cfg, sks):
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p, genesis_fork_version=cfg.GENESIS_FORK_VERSION)
+    state = genesis.copy()
+    process_slots(state, 2 * p.SLOTS_PER_EPOCH - 1, p, cfg)
+    t = ssz_types(p)
+    ctx = EpochContext(state, p)
+
+    # attest every slot of epoch 1 that is in history
+    from lodestar_tpu.state_transition.altair import process_attestation_altair
+
+    for slot in range(p.SLOTS_PER_EPOCH, state.slot):
+        for ci in range(ctx.get_committee_count_per_slot(slot // p.SLOTS_PER_EPOCH)):
+            committee = ctx.get_beacon_committee(slot, ci)
+            att = t.Attestation.default()
+            att.aggregation_bits = [True] * len(committee)
+            att.data.slot = slot
+            att.data.index = ci
+            att.data.beacon_block_root = get_block_root_at_slot(state, slot, p)
+            att.data.source = state.current_justified_checkpoint
+            tgt = t.Checkpoint.default()
+            tgt.epoch = 1
+            tgt.root = get_block_root(state, 1, p)
+            att.data.target = tgt
+            if att.data.slot + p.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot:
+                process_attestation_altair(state, att, ctx, verify_signatures=False)
+
+    # flags set for attesters
+    assert any(f > 0 for f in state.current_epoch_participation)
+    # justification for epoch-1 flags is computed at the END of epoch 2
+    # (the spec skips justification while current_epoch <= 1)
+    process_slots(state, 2 * p.SLOTS_PER_EPOCH, p, cfg)
+    # participation rotated at the epoch-1 boundary
+    assert any(f > 0 for f in state.previous_epoch_participation)
+    assert all(f == 0 for f in state.current_epoch_participation)
+    process_slots(state, 3 * p.SLOTS_PER_EPOCH + 1, p, cfg)
+    assert state.current_justified_checkpoint.epoch >= 1
