@@ -77,7 +77,7 @@ class BeaconApiImpl:
 
     def get_block_header(self, block_id: str) -> dict:
         root = self._block_root(block_id)
-        signed = self.chain.blocks_db.get(root)
+        signed = self.chain.get_block_by_root(root)
         if signed is None:
             raise ApiError(404, f"block {block_id} not found")
         header = self.t.BeaconBlockHeader.default()
@@ -115,7 +115,7 @@ class BeaconApiImpl:
 
     def get_block_v2(self, block_id: str) -> dict:
         root = self._block_root(block_id)
-        signed = self.chain.blocks_db.get(root)
+        signed = self.chain.get_block_by_root(root)
         if signed is None:
             raise ApiError(404, f"block {block_id} not found")
         return {

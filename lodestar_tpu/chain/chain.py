@@ -116,6 +116,8 @@ class BeaconChain:
         self.op_pool = OpPool()
         # optional eth1 provider for block production (execution.eth1)
         self.eth1 = None
+        # optional light-client server (chain.light_client_server)
+        self.light_client_server = None
         self.seen_attesters = SeenAttesters()
 
         # anchor: latest block header of the anchor state defines the root
@@ -148,6 +150,23 @@ class BeaconChain:
         )
         self._subscribers: dict[str, list[Callable]] = {"block": [], "head": [], "finalized": []}
 
+    # -- fork-aware types ------------------------------------------------------
+
+    def fork_name_at_slot(self, slot: int) -> str:
+        cfg = self.cfg
+        if cfg is None:
+            return "phase0"
+        epoch = slot // self.p.SLOTS_PER_EPOCH
+        name = "phase0"
+        for fork in ("altair", "bellatrix", "capella", "deneb"):
+            if getattr(cfg, f"{fork.upper()}_FORK_EPOCH", 2**64 - 1) <= epoch:
+                name = fork
+        return name
+
+    def block_type_at_slot(self, slot: int):
+        ns = getattr(self.types, self.fork_name_at_slot(slot))
+        return ns.BeaconBlock, ns.SignedBeaconBlock
+
     # -- events ---------------------------------------------------------------
 
     def on(self, event: str, fn: Callable) -> None:
@@ -165,6 +184,18 @@ class BeaconChain:
         self.fork_choice.on_tick(slot)
         self.attestation_pool.prune(slot)
         self.aggregated_attestation_pool.prune(slot)
+
+    # -- block store -----------------------------------------------------------
+
+    def get_block_by_root(self, block_root: bytes):
+        """Fork-aware decode from the hot block db."""
+        raw = self.blocks_db.get_binary(block_root)
+        if raw is None:
+            return None
+        node = self.fork_choice.proto_array.get_block(_hex(block_root))
+        slot = node.slot if node is not None else 0
+        _, signed_type = self.block_type_at_slot(slot)
+        return signed_type.deserialize(raw)
 
     # -- regen ----------------------------------------------------------------
 
@@ -189,7 +220,7 @@ class BeaconChain:
             root = parent
         # replay forward
         for r in reversed(chain):
-            signed = self.blocks_db.get(r)
+            signed = self.get_block_by_root(r)
             if signed is None:
                 raise BlockError(BlockErrorCode.PRESTATE_MISSING, f"block {_hex(r)} not in db")
             st = self._replay_block(st, signed)
@@ -212,7 +243,8 @@ class BeaconChain:
         """Full import pipeline for one gossip/sync block."""
         t = self.types
         block = signed_block.message
-        block_root = t.phase0.BeaconBlock.hash_tree_root(block)
+        block_type, signed_type = self.block_type_at_slot(block.slot)
+        block_root = block_type.hash_tree_root(block)
 
         # 1. sanity (verifyBlocksSanityChecks.ts)
         if self.fork_choice.proto_array.has_block(_hex(block_root)):
@@ -275,7 +307,7 @@ class BeaconChain:
             raise BlockError(BlockErrorCode.INVALID_SIGNATURES, _hex(block_root))
 
         # 4. import (importBlock.ts:51)
-        self.blocks_db.put(block_root, signed_block)
+        self.blocks_db.put_binary(block_root, signed_type.serialize(signed_block))
         self.state_cache.add(block_root, post_state)
 
         blk_epoch = compute_epoch_at_slot(block.slot, self.p)
@@ -317,6 +349,8 @@ class BeaconChain:
             )
 
         head = self.fork_choice.update_head()
+        if self.light_client_server is not None:
+            self.light_client_server.on_imported_block(signed_block, post_state)
         self._emit("block", block_root, signed_block)
         self._emit("head", head)
         if self.metrics is not None:

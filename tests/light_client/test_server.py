@@ -1,0 +1,94 @@
+"""Server -> client light sync loop: an altair chain with real sync
+aggregates feeds the LightClientServer; a LightClientStore bootstraps
+from it and follows updates with full verification."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.light_client_server import LightClientServer
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.light_client import LightClientStore, validate_light_client_update
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+
+from ..state_transition.test_altair import _altair_block
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def test_server_produces_verifiable_updates(minimal_preset):
+    p = minimal_preset
+    far = 2**64 - 1
+    cfg = minimal_chain_config().replace(
+        ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far
+    )
+    sks = interop_secret_keys(N)
+    genesis_phase0 = create_interop_genesis_state(
+        N, p=p, genesis_fork_version=cfg.GENESIS_FORK_VERSION
+    )
+    from lodestar_tpu.state_transition.altair import upgrade_to_altair
+
+    genesis = upgrade_to_altair(genesis_phase0, cfg, p)
+
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        cfg=cfg,
+        current_slot=3,
+    )
+    server = LightClientServer(chain)
+    chain.light_client_server = server
+
+    async def go():
+        state = genesis
+        for slot in (1, 2, 3):
+            signed = _altair_block(state, slot, sks, p, cfg)
+            await chain.process_block(signed)
+            from lodestar_tpu.state_transition import state_transition
+
+            state = state_transition(
+                state, signed, p, cfg, verify_signatures=False, verify_proposer_signature=False
+            )
+
+    asyncio.run(go())
+
+    # bootstrap from the head block
+    boot = server.get_bootstrap(chain.head_root)
+    assert len(boot.current_sync_committee.pubkeys) == p.SYNC_COMMITTEE_SIZE
+
+    # the optimistic update verifies against a store holding the committee
+    update = server.get_optimistic_update()
+    assert update is not None
+    store = LightClientStore(
+        finalized_header=boot.header,
+        current_sync_committee=boot.current_sync_committee,
+        p=p,
+    )
+    validate_light_client_update(
+        store,
+        update,
+        bytes(genesis.genesis_validators_root),
+        bytes(genesis.fork.current_version),
+        p,
+    )
+    # and the store applies it
+    store.process_update(
+        update, bytes(genesis.genesis_validators_root), bytes(genesis.fork.current_version)
+    )
+    assert store.optimistic_header.beacon.slot == update.attested_header.beacon.slot
+    assert server.get_updates(0, 1)  # best-by-period tracked
