@@ -1,0 +1,90 @@
+"""Beacon-node req/resp handlers over the chain.
+
+Reference `beacon-node/src/network/reqresp/ReqRespBeaconNode.ts:61` +
+`handlers/index.ts`: status from fork choice, blocksByRange/Root from the
+hot db + canonical chain walk, ping/metadata from local state.
+"""
+
+from __future__ import annotations
+
+from lodestar_tpu.reqresp import RateLimiterQuota, ReqResp, ReqRespError
+from lodestar_tpu.types import ssz_types
+
+__all__ = ["ReqRespBeaconNode", "MAX_REQUEST_BLOCKS_PER_CALL"]
+
+MAX_REQUEST_BLOCKS_PER_CALL = 1024
+
+
+def _pid(name: str, version: int = 1) -> str:
+    return f"/eth2/beacon_chain/req/{name}/{version}/ssz_snappy"
+
+
+class ReqRespBeaconNode(ReqResp):
+    """ReqResp engine with the beacon protocol handlers registered."""
+
+    def __init__(self, chain, *, metadata_seq: int = 0, **kw):
+        super().__init__(**kw)
+        self.chain = chain
+        self._seq = metadata_seq
+        self.register_handler(_pid("status"), self._on_status)
+        self.register_handler(_pid("ping"), self._on_ping)
+        self.register_handler(_pid("metadata"), self._on_metadata)
+        self.register_handler(
+            _pid("beacon_blocks_by_range"),
+            self._on_blocks_by_range,
+            quota=RateLimiterQuota(500, 10.0),
+        )
+        self.register_handler(
+            _pid("beacon_blocks_by_root"),
+            self._on_blocks_by_root,
+            quota=RateLimiterQuota(128, 10.0),
+        )
+
+    # -- handlers -------------------------------------------------------------
+
+    def local_status(self):
+        t = ssz_types(self.chain.p)
+        fc = self.chain.fork_choice
+        head = fc.proto_array.get_block(fc.head)
+        status = t.Status.default()
+        status.finalized_root = bytes.fromhex(fc.finalized.root[2:])
+        status.finalized_epoch = fc.finalized.epoch
+        status.head_root = bytes.fromhex(head.block_root[2:]) if head else b"\x00" * 32
+        status.head_slot = head.slot if head else 0
+        return status
+
+    async def _on_status(self, req, peer):
+        yield self.local_status()
+
+    async def _on_ping(self, req, peer):
+        yield self._seq
+
+    async def _on_metadata(self, req, peer):
+        t = ssz_types(self.chain.p)
+        md = t.phase0.Metadata.default()
+        md.seq_number = self._seq
+        yield md
+
+    async def _on_blocks_by_range(self, req, peer):
+        if req.count == 0 or req.step != 1:
+            raise ReqRespError("invalid range request")
+        count = min(req.count, MAX_REQUEST_BLOCKS_PER_CALL)
+        # canonical walk: collect head-chain nodes within [start, start+count)
+        fc = self.chain.fork_choice.proto_array
+        node = fc.get_block(self.chain.fork_choice.head)
+        wanted = []
+        lo, hi = req.start_slot, req.start_slot + count
+        while node is not None and node.slot >= lo:
+            if node.slot < hi:
+                wanted.append(node)
+            node = fc.nodes[node.parent] if node.parent is not None else None
+        for n in reversed(wanted):
+            signed = self.chain.get_block_by_root(bytes.fromhex(n.block_root[2:]))
+            if signed is not None:
+                yield signed
+
+    async def _on_blocks_by_root(self, req, peer):
+        for root in list(req)[:MAX_REQUEST_BLOCKS_PER_CALL]:
+            signed = self.chain.get_block_by_root(bytes(root))
+            if signed is not None:
+                yield signed

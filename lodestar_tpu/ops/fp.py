@@ -200,32 +200,30 @@ def _mont_reduce(t):
     t limbs are < 2^30 coming in; each of the 32 steps clears one low limb
     (adding m*p keeps limbs < 2^30 + 2^24*1 per step, bounded < 2^31).
 
-    The 32 steps are STATICALLY unrolled (Python loop, static slices):
-    a `fori_loop` here compiles to a While whose per-iteration dispatch
-    overhead (~tens of µs on a real chip) dominates the entire pairing —
-    the sequential mont_mul chain inside one Miller `lax.scan` step would
-    pay it hundreds of times per step. Unrolled, the whole reduction is a
-    flat elementwise graph XLA fuses into a handful of kernels, and the
-    graph stays small because the Miller/exponentiation loop bodies are
-    traced once under scan.
-
-    Instead of mutating a 64-limb buffer with per-step updates, the m*p
-    additions accumulate into a running carry chain over the high half:
-    step i only needs t[i]'s current low limb, which equals
-    t_in[i] + (m*p + carries so far)[i].
+    Kept as a `fori_loop` (unroll=4) deliberately: a fully static unroll
+    was measured on the real chip at IDENTICAL runtime (the program is
+    latency-bound elsewhere) while tripling XLA compile time, so the
+    rolled form wins on compile cost with nothing given up.
     """
     p_limbs = jnp.asarray(P_LIMBS)
-    # `tail` holds limbs [i:] of the accumulator; limbs below i are dead
-    # once their m is extracted, so each step shrinks it by one.
-    tail = t
-    for _ in range(LIMBS):
-        m = ((tail[..., 0] & LIMB_MASK) * PPRIME) & LIMB_MASK
-        head = tail[..., :LIMBS] + m[..., None] * p_limbs
-        c = head[..., 0] >> LIMB_BITS  # low limb now 0 mod 2^12
-        tail = jnp.concatenate(
-            [(head[..., 1] + c)[..., None], head[..., 2:], tail[..., LIMBS:]], axis=-1
-        )
-    return _cond_sub_p(_carry_full(tail, passes=4))
+
+    def body(i, t):
+        ci = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
+        m = ((ci & LIMB_MASK) * PPRIME) & LIMB_MASK
+        # t[i : i+32] += m * p
+        window = jax.lax.dynamic_slice_in_dim(t, i, LIMBS, axis=-1)
+        window = window + m[..., None] * p_limbs
+        t = jax.lax.dynamic_update_slice_in_dim(t, window, i, axis=-1)
+        # low limb of t[i] is now 0 mod 2^12; push its carry into t[i+1]
+        ci2 = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
+        carry = ci2 >> LIMB_BITS
+        nxt = jax.lax.dynamic_index_in_dim(t, i + 1, axis=-1, keepdims=False) + carry
+        t = jax.lax.dynamic_update_index_in_dim(t, nxt, i + 1, axis=-1)
+        return t
+
+    t = jax.lax.fori_loop(0, LIMBS, body, t, unroll=4)
+    hi = t[..., LIMBS:]
+    return _cond_sub_p(_carry_full(hi, passes=4))
 
 
 def mont_mul(a, b):
@@ -274,10 +272,8 @@ def pow_const(a, e: int):
         mul = jnp.where(bit[..., None] != 0, a, one)
         return mont_mul(r, mul)
 
-    # first bit is always 1: start from a. unroll to amortize While-op
-    # dispatch overhead (the 381-bit Fermat chain is the only long loop
-    # left after _mont_reduce's static unroll).
-    return jax.lax.fori_loop(1, bits.shape[0], body, a, unroll=8)
+    # first bit is always 1: start from a
+    return jax.lax.fori_loop(1, bits.shape[0], body, a)
 
 
 def inv(a):

@@ -141,3 +141,73 @@ def test_peer_manager_prunes_worst():
     state = pm.report_peer("p1", PeerAction.FATAL)
     assert state is ScoreState.BANNED
     assert pm.connected_peers() == ["p3"]
+
+
+def test_reqresp_beacon_node_serves_chain():
+    """Two-node sync over real TCP: a fresh node range-syncs from a
+    serving node's ReqRespBeaconNode handlers."""
+    import asyncio
+
+    from lodestar_tpu.chain.bls import BlsVerifierMock
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.network.reqresp_node import ReqRespBeaconNode
+    from lodestar_tpu.reqresp import ReqResp
+    from lodestar_tpu.state_transition.genesis import (
+        create_interop_genesis_state,
+        interop_secret_keys,
+    )
+    from lodestar_tpu.sync import RangeSync
+    from lodestar_tpu.types import ssz_types
+
+    from ..chain.test_chain import _chain_of_blocks
+
+    async def go():
+        p = params.active_preset()
+        sks = interop_secret_keys(16)
+        genesis = create_interop_genesis_state(16, p=p)
+        t = ssz_types(p)
+
+        server_chain = BeaconChain(
+            anchor_state=genesis, bls_verifier=BlsVerifierMock(True),
+            db=MemoryDbController(), current_slot=4,
+        )
+        blocks = _chain_of_blocks(genesis, sks, p, 4)
+        for b in blocks:
+            await server_chain.process_block(b)
+
+        node = ReqRespBeaconNode(server_chain)
+        server = await asyncio.start_server(
+            lambda r, w: node.handle_stream(r, w, "client"), "127.0.0.1", 0
+        )
+        port = server.sockets[0].getsockname()[1]
+
+        async def dial():
+            return await asyncio.open_connection("127.0.0.1", port)
+
+        client = ReqResp()
+        pid = "/eth2/beacon_chain/req/status/1/ssz_snappy"
+        status = (await client.send_request(dial, pid, t.Status.default()))[0]
+        assert status.head_slot == 4
+
+        # a fresh chain syncs over the wire
+        class WireNet:
+            async def blocks_by_range(self, peer, start, count):
+                req = t.BeaconBlocksByRangeRequest.default()
+                req.start_slot = start
+                req.count = count
+                req.step = 1
+                return await client.send_request(
+                    dial, "/eth2/beacon_chain/req/beacon_blocks_by_range/1/ssz_snappy", req
+                )
+
+        fresh = BeaconChain(
+            anchor_state=genesis, bls_verifier=BlsVerifierMock(True),
+            db=MemoryDbController(), current_slot=4,
+        )
+        res = await RangeSync(chain=fresh, network=WireNet(), peers=["srv"]).sync(1, 4)
+        assert res.completed
+        assert fresh.head_root == server_chain.head_root
+        server.close()
+
+    asyncio.run(go())
