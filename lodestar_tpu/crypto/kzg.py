@@ -6,10 +6,12 @@ pairing stack: commitments are MSMs over the Lagrange trusted setup
 (device `ops.msm` for the 4096-point blob commitment), proof verification
 is two pairings through the byte-exact CPU oracle.
 
-`trusted_setup.bin` is the public KZG ceremony output (4096 G1 Lagrange
-points in bit-reversed order + 65 G2 monomial points; format
-header u32be(4096) u32be(96) then compressed points — c-kzg-4844 issue #3,
-same file the reference ships at `beacon-node/trusted_setup.bin`).
+`trusted_setup.bin` is the public KZG ceremony output, MONOMIAL form:
+4096 G1 points [tau^i]G1 + 65 G2 points [tau^i]G2 (verified here by the
+pairing identity e([tau]1, G2) == e(G1, [tau]2); format header
+u32be(4096) u32be(96) then compressed points — same file the reference
+ships at `beacon-node/trusted_setup.bin`). Blob commitments therefore go
+evaluation form -> coefficients (inverse NTT over Fr) -> monomial MSM.
 """
 
 from __future__ import annotations
@@ -49,8 +51,8 @@ class KzgError(Exception):
 
 @lru_cache(maxsize=1)
 def load_trusted_setup(path: str = _SETUP_PATH):
-    """-> (g1_lagrange: list of oracle affine points (bit-reversed order),
-    g2_monomial: list of oracle G2 affine points)."""
+    """-> (g1_monomial: [tau^i]G1 oracle affine points,
+    g2_monomial: [tau^i]G2 oracle G2 affine points)."""
     with open(path, "rb") as f:
         data = f.read()
     n_g1 = int.from_bytes(data[0:4], "big")
@@ -114,15 +116,56 @@ def _blob_to_scalars(blob: bytes) -> list[int]:
 # --- commitments -------------------------------------------------------------
 
 
+def _inverse_ntt(evals_natural: list[int]) -> list[int]:
+    """Inverse radix-2 NTT over Fr: evaluations at the natural-order
+    domain -> monomial coefficients."""
+    n = len(evals_natural)
+    if n & (n - 1):
+        raise KzgError("domain size must be a power of two")
+    # forward NTT with the inverse root, then scale by n^-1
+    w_inv = pow(pow(_GENERATOR, (R - 1) // n, R), R - 2, R)
+    out = _ntt(evals_natural, w_inv)
+    n_inv = pow(n, R - 2, R)
+    return [v * n_inv % R for v in out]
+
+
+def _ntt(values: list[int], omega: int) -> list[int]:
+    n = len(values)
+    if n == 1:
+        return list(values)
+    # iterative Cooley-Tukey, decimation in time
+    a = [values[_bit_reverse(i, n)] for i in range(n)]
+    length = 2
+    while length <= n:
+        w_len = pow(omega, n // length, R)
+        for start in range(0, n, length):
+            w = 1
+            half = length // 2
+            for i in range(start, start + half):
+                u, v = a[i], a[i + half] * w % R
+                a[i] = (u + v) % R
+                a[i + half] = (u - v) % R
+                w = w * w_len % R
+        length <<= 1
+    return a
+
+
 def blob_to_kzg_commitment(blob: bytes, *, device: bool = True) -> bytes:
-    """MSM of the blob's field elements over the Lagrange setup
+    """Blob (evaluation form over the bit-reversed domain) -> monomial
+    coefficients (inverse NTT) -> MSM over the monomial setup
     (device=True routes through ops.msm — the 4096-point G1 MSM is the
     KZG hot loop BASELINE's plan earmarked for the device)."""
     g1, _ = load_trusted_setup()
     scalars = _blob_to_scalars(blob)
     if len(scalars) != len(g1):
         raise KzgError(f"blob has {len(scalars)} elements, setup {len(g1)}")
-    return _commit_msm(g1, scalars, device)
+    n = len(scalars)
+    # undo the bit-reversal storage order, then interpolate
+    evals_natural = [0] * n
+    for i, v in enumerate(scalars):
+        evals_natural[_bit_reverse(i, n)] = v
+    coeffs = _inverse_ntt(evals_natural)
+    return _commit_msm(g1, coeffs, device)
 
 
 def _commit_msm(g1, scalars, device: bool) -> bytes:
