@@ -2,9 +2,10 @@
 C binding, `beacon-node/src/util/kzg.ts` + `chain/validation/blobsSidecar.ts`).
 
 Written from the public polynomial-commitments spec over this repo's own
-pairing stack: commitments are MSMs over the Lagrange trusted setup
-(device `ops.msm` for the 4096-point blob commitment), proof verification
-is two pairings through the byte-exact CPU oracle.
+pairing stack: commitments are MSMs over the MONOMIAL trusted setup
+(device `ops.msm` for the 4096-point blob commitment, after an inverse
+NTT takes the blob from evaluation to coefficient form), proof
+verification is two pairings through the byte-exact CPU oracle.
 
 `trusted_setup.bin` is the public KZG ceremony output, MONOMIAL form:
 4096 G1 points [tau^i]G1 + 65 G2 points [tau^i]G2 (verified here by the

@@ -103,22 +103,39 @@ def process_slots(state, slot: int, p: BeaconPreset | None = None, cfg=None):
 
                 process_epoch_altair(state, EpochContext(state, p), cfg)
         state.slot += 1
-        # scheduled upgrade at the first slot of the activation epoch
-        if (
-            cfg is not None
-            and state.slot % p.SLOTS_PER_EPOCH == 0
-            and fork_of(state) == "phase0"
-            and getattr(cfg, "ALTAIR_FORK_EPOCH", 2**64 - 1) == state.slot // p.SLOTS_PER_EPOCH
-        ):
-            from .altair import upgrade_to_altair
+        # scheduled upgrades at the first slot of each activation epoch
+        if cfg is not None and state.slot % p.SLOTS_PER_EPOCH == 0:
+            _maybe_upgrade_fork(state, cfg, p)
+    return EpochContext(state, p)
 
-            upgraded = upgrade_to_altair(state, cfg, p)
-            # mutate-in-place semantics: swap the container contents
+
+# (prior_fork, activation-epoch config key, upgrade fn import) in order
+_UPGRADE_SCHEDULE = (
+    ("phase0", "ALTAIR_FORK_EPOCH", "altair", "upgrade_to_altair"),
+    ("altair", "BELLATRIX_FORK_EPOCH", "bellatrix", "upgrade_to_bellatrix"),
+    ("bellatrix", "CAPELLA_FORK_EPOCH", "capella", "upgrade_to_capella"),
+    ("capella", "DENEB_FORK_EPOCH", "deneb", "upgrade_to_deneb"),
+)
+
+
+def _maybe_upgrade_fork(state, cfg, p: BeaconPreset) -> None:
+    """Run the scheduled fork upgrade if the state just crossed an
+    activation epoch. Upgrades swap the container contents in place so
+    every existing reference to `state` observes the new fork (reference
+    `stateTransition.ts processSlotsWithTransientCache`)."""
+    import importlib
+
+    from .block import fork_of
+
+    epoch = state.slot // p.SLOTS_PER_EPOCH
+    for prior, key, module, fn_name in _UPGRADE_SCHEDULE:
+        if fork_of(state) == prior and getattr(cfg, key, 2**64 - 1) == epoch:
+            mod = importlib.import_module(f".{module}", __package__)
+            upgraded = getattr(mod, fn_name)(state, cfg, p)
             state.__dict__.clear()
             object.__setattr__(state, "_type", upgraded.type)
             for name in upgraded.type._field_names:
                 setattr(state, name, getattr(upgraded, name))
-    return EpochContext(state, p)
 
 
 def state_transition(

@@ -360,10 +360,19 @@ def process_rewards_and_penalties_altair(state, status: AltairEpochStatus, p: Be
         if flag_index != TIMELY_HEAD_FLAG_INDEX:
             penalties[miss] += (base_rewards * weight // WEIGHT_DENOMINATOR)[miss]
 
-    # inactivity penalties (quadratic leak via scores)
+    # inactivity penalties (quadratic leak via scores); the quotient
+    # tightens at bellatrix (reference getRewardsAndPenaltiesAltair uses
+    # fork-selected INACTIVITY_PENALTY_QUOTIENT)
+    from .block import fork_of
+
     scores = np.asarray(state.inactivity_scores, dtype=np.int64)
     not_target = status.eligible & ~status.prev_flags[TIMELY_TARGET_FLAG_INDEX]
-    penalty_denominator = INACTIVITY_SCORE_BIAS * p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    quotient = (
+        p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+        if fork_of(state) == "altair"
+        else p.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    )
+    penalty_denominator = INACTIVITY_SCORE_BIAS * quotient
     penalties[not_target] += (status.eb * scores // penalty_denominator)[not_target]
 
     balances = np.asarray(state.balances, dtype=np.int64)
@@ -371,9 +380,16 @@ def process_rewards_and_penalties_altair(state, status: AltairEpochStatus, p: Be
 
 
 def process_slashings_altair(state, status: AltairEpochStatus, p: BeaconPreset) -> None:
+    from .block import fork_of
+
     epoch = get_current_epoch(state)
     total = status.total_active_balance
-    adjusted = min(int(sum(state.slashings)) * p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR, total)
+    multiplier = (
+        p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+        if fork_of(state) == "altair"
+        else p.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    )
+    adjusted = min(int(sum(state.slashings)) * multiplier, total)
     inc = p.EFFECTIVE_BALANCE_INCREMENT
     target_wd = epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2
     mask = status.slashed & (status.withdrawable_epochs == target_wd)
@@ -404,15 +420,20 @@ def process_epoch_altair(state, ctx: EpochContext | None = None, cfg=None) -> No
         process_slashings_reset,
     )
 
+    from .block import fork_of
+
     ctx = ctx or EpochContext(state)
     p = ctx.p
+    fork = fork_of(state)
     status = AltairEpochStatus(state, ctx)
     process_justification_and_finalization_altair(state, status)
     process_inactivity_updates(state, status, p)
     process_rewards_and_penalties_altair(state, status, p)
 
     # registry/slashings/final updates reuse the phase0 code (same spec
-    # logic; slashings use the altair multiplier)
+    # logic); the slashing multiplier tightens at bellatrix and capella
+    # replaces historical roots with summaries (reference
+    # `epoch/index.ts:45-61`)
     class _EP:
         pass
 
@@ -425,7 +446,12 @@ def process_epoch_altair(state, ctx: EpochContext | None = None, cfg=None) -> No
     process_effective_balance_updates(state, ep)
     process_slashings_reset(state, ep)
     process_randao_mixes_reset(state, ep)
-    process_historical_roots_update(state, ep)
+    if fork in ("capella", "deneb"):
+        from .capella import process_historical_summaries_update
+
+        process_historical_summaries_update(state, p)
+    else:
+        process_historical_roots_update(state, ep)
     process_participation_flag_updates(state)
     process_sync_committee_updates(state, p)
 

@@ -184,7 +184,13 @@ def get_indexed_attestation(attestation, ctx: EpochContext):
 
 
 def slash_validator(state, slashed_index: int, ctx: EpochContext, whistleblower_index: int | None = None, cfg=None) -> None:
+    """Fork-aware slashing: the penalty quotient tightens per fork and
+    altair+ splits the whistleblower reward by PROPOSER_WEIGHT (reference
+    `block/slashValidator.ts:45-58`)."""
+    from lodestar_tpu.params import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
+
     p = ctx.p
+    fork = fork_of(state)
     epoch = get_current_epoch(state)
     churn_quotient = cfg.CHURN_LIMIT_QUOTIENT if cfg is not None else 65536
     min_churn = cfg.MIN_PER_EPOCH_CHURN_LIMIT if cfg is not None else 4
@@ -193,13 +199,22 @@ def slash_validator(state, slashed_index: int, ctx: EpochContext, whistleblower_
     v.slashed = True
     v.withdrawable_epoch = max(v.withdrawable_epoch, epoch + p.EPOCHS_PER_SLASHINGS_VECTOR)
     state.slashings[epoch % p.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
-    decrease_balance(state, slashed_index, v.effective_balance // p.MIN_SLASHING_PENALTY_QUOTIENT)
+    if fork == "phase0":
+        quotient = p.MIN_SLASHING_PENALTY_QUOTIENT
+    elif fork == "altair":
+        quotient = p.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    else:
+        quotient = p.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    decrease_balance(state, slashed_index, v.effective_balance // quotient)
 
     proposer_index = ctx.get_beacon_proposer(state.slot)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
     whistleblower_reward = v.effective_balance // p.WHISTLEBLOWER_REWARD_QUOTIENT
-    proposer_reward = whistleblower_reward // p.PROPOSER_REWARD_QUOTIENT
+    if fork == "phase0":
+        proposer_reward = whistleblower_reward // p.PROPOSER_REWARD_QUOTIENT
+    else:
+        proposer_reward = whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
     increase_balance(state, proposer_index, proposer_reward)
     increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)
 
@@ -397,15 +412,52 @@ def process_operations(state, body, ctx: EpochContext, verify_signatures: bool =
         process_deposit(state, dep, ctx, cfg)
     for ex in body.voluntary_exits:
         process_voluntary_exit(state, ex, ctx, verify_signatures, cfg)
+    if fork_of(state) in ("capella", "deneb"):
+        from .capella import process_bls_to_execution_change
+
+        for change in body.bls_to_execution_changes:
+            process_bls_to_execution_change(state, change, ctx, verify_signatures, cfg)
 
 
-def process_block(state, block, ctx: EpochContext, verify_signatures: bool = True, cfg=None) -> None:
-    """Spec process_block, fork-dispatched (reference `block/index.ts`)."""
+def process_block(
+    state,
+    block,
+    ctx: EpochContext,
+    verify_signatures: bool = True,
+    cfg=None,
+    payload_status: str = "valid",
+) -> None:
+    """Spec process_block, fork-dispatched (reference `block/index.ts:31`).
+
+    Execution-payload processing runs before randao (the payload's
+    prev_randao is the mix from the previous block's reveal); capella
+    adds withdrawals ahead of the payload; deneb checks blob KZG
+    commitment consistency last."""
+    fork = fork_of(state)
     process_block_header(state, block, ctx)
+    if fork in ("bellatrix", "capella", "deneb"):
+        from .bellatrix import is_execution_enabled, process_execution_payload
+
+        body = block.body
+        payload = (
+            body.execution_payload_header
+            if hasattr(body, "execution_payload_header")
+            else body.execution_payload
+        )
+        if is_execution_enabled(state, body, ctx.p):
+            if fork in ("capella", "deneb"):
+                from .capella import process_withdrawals
+
+                process_withdrawals(state, payload, ctx)
+            process_execution_payload(state, payload, ctx, cfg, payload_status)
     process_randao(state, block.body, ctx, verify_signatures)
     process_eth1_data(state, block.body, ctx)
     process_operations(state, block.body, ctx, verify_signatures, cfg)
-    if fork_of(state) != "phase0":
+    if fork != "phase0":
         from .altair import process_sync_aggregate
 
         process_sync_aggregate(state, block.body.sync_aggregate, ctx, verify_signatures)
+    if fork == "deneb" and not hasattr(block.body, "execution_payload_header"):
+        from .deneb import process_blob_kzg_commitments
+
+        process_blob_kzg_commitments(block.body)

@@ -1,0 +1,309 @@
+"""Bellatrix/capella/deneb: execution payloads, withdrawals, BLS
+changes, blob-commitment checks, chained fork upgrades.
+
+Mirrors the reference's processExecutionPayload/processWithdrawals/
+processBlsToExecutionChange/processBlobKzgCommitments unit coverage
+(`packages/state-transition/src/block/*.ts`)."""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.config import compute_domain, compute_signing_root, minimal_chain_config
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.params import DOMAIN_BLS_TO_EXECUTION_CHANGE
+from lodestar_tpu.state_transition import (
+    BlockProcessError,
+    EpochContext,
+    process_block,
+    process_slots,
+)
+from lodestar_tpu.state_transition.bellatrix import (
+    compute_timestamp_at_slot,
+    is_execution_enabled,
+    is_merge_transition_complete,
+    process_execution_payload,
+    upgrade_to_bellatrix,
+)
+from lodestar_tpu.state_transition.block import fork_of
+from lodestar_tpu.state_transition.capella import (
+    get_expected_withdrawals,
+    process_bls_to_execution_change,
+    process_historical_summaries_update,
+    process_withdrawals,
+)
+from lodestar_tpu.state_transition.deneb import (
+    BLOB_TX_TYPE,
+    OPAQUE_TX_BLOB_VERSIONED_HASHES_OFFSET,
+    OPAQUE_TX_MESSAGE_OFFSET,
+    kzg_commitment_to_versioned_hash,
+    process_blob_kzg_commitments,
+    verify_kzg_commitments_against_transactions,
+)
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.state_transition.util import get_randao_mix
+from lodestar_tpu.types import ssz_types
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+@pytest.fixture(scope="module")
+def sks():
+    return interop_secret_keys(N)
+
+
+def _cfg(**fork_epochs):
+    far = 2**64 - 1
+    base = dict(
+        ALTAIR_FORK_EPOCH=far, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far
+    )
+    base.update(fork_epochs)
+    return minimal_chain_config().replace(**base)
+
+
+def _state_at_fork(fork: str, p, cfg=None):
+    """Genesis -> process_slots across epoch 1 with all upgrades through
+    `fork` scheduled at epoch 1 (exercises chained upgrades)."""
+    order = ("altair", "bellatrix", "capella", "deneb")
+    epochs = {f"{f.upper()}_FORK_EPOCH": 1 for f in order[: order.index(fork) + 1]}
+    cfg = cfg or _cfg(**epochs)
+    state = create_interop_genesis_state(N, p=p, genesis_fork_version=cfg.GENESIS_FORK_VERSION)
+    process_slots(state, p.SLOTS_PER_EPOCH, p, cfg)
+    assert fork_of(state) == fork
+    return state, cfg
+
+
+def test_chained_upgrade_to_deneb(minimal_preset):
+    p = minimal_preset
+    state, cfg = _state_at_fork("deneb", p)
+    assert bytes(state.fork.current_version) == cfg.DENEB_FORK_VERSION
+    assert bytes(state.fork.previous_version) == cfg.CAPELLA_FORK_VERSION
+    assert int(state.next_withdrawal_index) == 0
+    assert len(state.historical_summaries) == 0
+    # payload header carried through upgrades at default
+    assert not is_merge_transition_complete(state, p)
+
+
+def _payload_for(state, p, cfg, fork: str):
+    t = ssz_types(p)
+    ns = getattr(t, fork)
+    payload = ns.ExecutionPayload.default()
+    payload.parent_hash = b"\x11" * 32
+    payload.block_hash = b"\x22" * 32
+    payload.prev_randao = get_randao_mix(state, int(state.slot) // p.SLOTS_PER_EPOCH, p)
+    payload.timestamp = compute_timestamp_at_slot(state, int(state.slot), cfg)
+    return payload
+
+
+def test_bellatrix_process_execution_payload(minimal_preset):
+    p = minimal_preset
+    state, cfg = _state_at_fork("bellatrix", p)
+    ctx = EpochContext(state, p)
+    payload = _payload_for(state, p, cfg, "bellatrix")
+
+    bad = payload.copy()
+    bad.prev_randao = b"\x99" * 32
+    with pytest.raises(BlockProcessError, match="prev_randao"):
+        process_execution_payload(state.copy(), bad, ctx, cfg)
+
+    bad = payload.copy()
+    bad.timestamp = int(payload.timestamp) + 1
+    with pytest.raises(BlockProcessError, match="timestamp"):
+        process_execution_payload(state.copy(), bad, ctx, cfg)
+
+    with pytest.raises(BlockProcessError, match="invalid execution payload"):
+        process_execution_payload(state.copy(), payload, ctx, cfg, payload_status="invalid")
+
+    work = state.copy()
+    process_execution_payload(work, payload, ctx, cfg)
+    assert is_merge_transition_complete(work, p)
+    assert bytes(work.latest_execution_payload_header.block_hash) == b"\x22" * 32
+
+    # once merged, the next payload must chain on block_hash
+    ctx2 = EpochContext(work, p)
+    nxt = _payload_for(work, p, cfg, "bellatrix")
+    nxt.parent_hash = b"\x33" * 32
+    with pytest.raises(BlockProcessError, match="parent_hash"):
+        process_execution_payload(work.copy(), nxt, ctx2, cfg)
+    nxt.parent_hash = b"\x22" * 32
+    process_execution_payload(work, nxt, ctx2, cfg)
+
+
+def test_bellatrix_pre_merge_block_skips_payload(minimal_preset):
+    p = minimal_preset
+    state, cfg = _state_at_fork("bellatrix", p)
+    t = ssz_types(p)
+    body = t.bellatrix.BeaconBlockBody.default()
+    # default payload + default header => execution not enabled pre-merge
+    assert not is_execution_enabled(state, body, p)
+
+
+def test_capella_expected_withdrawals_and_processing(minimal_preset):
+    p = minimal_preset
+    state, cfg = _state_at_fork("capella", p)
+    ctx = EpochContext(state, p)
+
+    # validator 2: eth1 creds + fully withdrawable; validator 5: partial
+    addr2, addr5 = b"\xaa" * 20, b"\xbb" * 20
+    v2 = state.validators[2]
+    v2.withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr2
+    v2.withdrawable_epoch = 0
+    state.balances[2] = 7_000_000_000
+    v5 = state.validators[5]
+    v5.withdrawal_credentials = b"\x01" + b"\x00" * 11 + addr5
+    state.balances[5] = p.MAX_EFFECTIVE_BALANCE + 123_456  # eb == MAX => partial
+
+    expected = get_expected_withdrawals(state, ctx)
+    assert [int(w.validator_index) for w in expected] == [2, 5]
+    assert bytes(expected[0].address) == addr2
+    assert int(expected[0].amount) == 7_000_000_000
+    assert int(expected[1].amount) == 123_456
+
+    t = ssz_types(p)
+    payload = t.capella.ExecutionPayload.default()
+    payload.withdrawals = expected
+    work = state.copy()
+    process_withdrawals(work, payload, ctx)
+    assert int(work.balances[2]) == 0
+    assert int(work.balances[5]) == p.MAX_EFFECTIVE_BALANCE
+    assert int(work.next_withdrawal_index) == 2
+    # short of MAX_WITHDRAWALS_PER_PAYLOAD => sweep pointer jumps by the bound
+    assert int(work.next_withdrawal_validator_index) == (
+        p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP % N
+    )
+
+    # a payload whose withdrawal list disagrees is rejected
+    tampered = t.capella.ExecutionPayload.default()
+    wrong = [w.copy() for w in expected]
+    wrong[0].amount = 1
+    tampered.withdrawals = wrong
+    with pytest.raises(BlockProcessError, match="mismatch"):
+        process_withdrawals(state.copy(), tampered, ctx)
+
+
+def test_capella_bls_to_execution_change(minimal_preset, sks):
+    p = minimal_preset
+    state, cfg = _state_at_fork("capella", p)
+    ctx = EpochContext(state, p)
+    t = ssz_types(p)
+
+    vi = 3
+    sk = sks[vi]
+    from_pubkey = sk.to_pubkey()
+    creds = bytearray(hashlib.sha256(from_pubkey).digest())
+    creds[0] = 0  # BLS_WITHDRAWAL_PREFIX
+    state.validators[vi].withdrawal_credentials = bytes(creds)
+
+    change = t.BLSToExecutionChange.default()
+    change.validator_index = vi
+    change.from_bls_pubkey = from_pubkey
+    change.to_execution_address = b"\xcc" * 20
+    domain = compute_domain(
+        DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        cfg.GENESIS_FORK_VERSION,
+        bytes(state.genesis_validators_root),
+    )
+    signed = t.SignedBLSToExecutionChange.default()
+    signed.message = change
+    signed.signature = bls.sign(sk, compute_signing_root(t.BLSToExecutionChange, change, domain))
+
+    work = state.copy()
+    process_bls_to_execution_change(work, signed, ctx, verify_signatures=True, cfg=cfg)
+    new_creds = bytes(work.validators[vi].withdrawal_credentials)
+    assert new_creds[0] == 1 and new_creds[12:] == b"\xcc" * 20
+
+    # wrong signer rejected
+    bad = signed.copy()
+    bad.signature = bls.sign(sks[0], compute_signing_root(t.BLSToExecutionChange, change, domain))
+    with pytest.raises(BlockProcessError, match="signature"):
+        process_bls_to_execution_change(state.copy(), bad, ctx, verify_signatures=True, cfg=cfg)
+
+    # eth1-credentialed validator can't change again
+    with pytest.raises(BlockProcessError, match="BLS-prefixed"):
+        process_bls_to_execution_change(work, signed, ctx, verify_signatures=False, cfg=cfg)
+
+
+def test_capella_historical_summaries_update(minimal_preset):
+    p = minimal_preset
+    state, _ = _state_at_fork("capella", p)
+    # place the state so next_epoch hits the SLOTS_PER_HISTORICAL_ROOT cadence
+    period_epochs = p.SLOTS_PER_HISTORICAL_ROOT // p.SLOTS_PER_EPOCH
+    state.slot = (period_epochs - 1) * p.SLOTS_PER_EPOCH
+    process_historical_summaries_update(state, p)
+    assert len(state.historical_summaries) == 1
+    assert len(state.historical_roots) == 0  # frozen at capella
+
+
+def _blob_tx(versioned_hashes: list[bytes]) -> bytes:
+    """Opaque SignedBlobTransaction with hashes at the fixed offset
+    (layout per reference blobs.ts:20-21)."""
+    header_len = OPAQUE_TX_BLOB_VERSIONED_HASHES_OFFSET + 4
+    rel = header_len - OPAQUE_TX_MESSAGE_OFFSET
+    tx = bytearray(header_len)
+    tx[0] = BLOB_TX_TYPE
+    tx[OPAQUE_TX_BLOB_VERSIONED_HASHES_OFFSET:header_len] = rel.to_bytes(4, "little")
+    for h in versioned_hashes:
+        tx += h
+    return bytes(tx)
+
+
+def test_deneb_blob_kzg_commitment_consistency(minimal_preset):
+    p = minimal_preset
+    t = ssz_types(p)
+    commitments = [b"\x0c" * 48, b"\x0d" * 48]
+    hashes = [kzg_commitment_to_versioned_hash(c) for c in commitments]
+
+    assert verify_kzg_commitments_against_transactions([_blob_tx(hashes)], commitments)
+
+    body = t.deneb.BeaconBlockBody.default()
+    body.execution_payload.transactions = [_blob_tx(hashes)]
+    body.blob_kzg_commitments = commitments
+    process_blob_kzg_commitments(body)
+
+    # wrong hash
+    with pytest.raises(BlockProcessError, match="versioned hash"):
+        verify_kzg_commitments_against_transactions(
+            [_blob_tx([hashes[1], hashes[0]])], commitments
+        )
+    # count mismatch
+    with pytest.raises(BlockProcessError, match="commitments"):
+        verify_kzg_commitments_against_transactions([_blob_tx(hashes[:1])], commitments)
+    # non-blob txs are ignored
+    assert verify_kzg_commitments_against_transactions([b"\x02" + b"\x00" * 80], [])
+
+
+def test_deneb_block_via_process_block(minimal_preset, sks):
+    """Full deneb process_block with an execution payload carrying a blob
+    tx (verify_signatures off: payload/withdrawals/blob paths in one go).
+    """
+    p = minimal_preset
+    state, cfg = _state_at_fork("deneb", p)
+    t = ssz_types(p)
+    ctx = process_slots(state, state.slot + 1, p, cfg)
+
+    commitment = b"\x0e" * 48
+    payload = _payload_for(state, p, cfg, "deneb")
+    payload.transactions = [_blob_tx([kzg_commitment_to_versioned_hash(commitment)])]
+
+    block = t.deneb.BeaconBlock.default()
+    block.slot = state.slot
+    block.proposer_index = ctx.get_beacon_proposer(int(state.slot))
+    block.parent_root = t.BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+    block.body.eth1_data = state.eth1_data
+    block.body.execution_payload = payload
+    block.body.blob_kzg_commitments = [commitment]
+
+    process_block(state, block, ctx, verify_signatures=False, cfg=cfg)
+    assert is_merge_transition_complete(state, p)
+    assert int(state.latest_execution_payload_header.excess_data_gas) == 0
