@@ -41,8 +41,7 @@ class BeaconApiImpl:
         if state_id == "genesis":
             raise ApiError(501, "genesis state queries need the archive")
         if state_id == "finalized":
-            root = bytes.fromhex(chain.fork_choice.finalized.root[2:])
-            st = chain.state_cache.get(root) or chain.states_db.get(root)
+            st = chain.get_finalized_state()
             if st is None:
                 raise ApiError(404, "finalized state not found")
             return st

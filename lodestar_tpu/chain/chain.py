@@ -98,6 +98,7 @@ class BeaconChain:
         genesis_block_root: bytes | None = None,
         current_slot: int | None = None,
         metrics=None,
+        archive_state_epoch_frequency: int | None = None,
     ) -> None:
         self.p = p = p or active_preset()
         self.cfg = cfg
@@ -111,6 +112,17 @@ class BeaconChain:
         self.states_db: Repository = Repository(db, Bucket.allForks_stateArchive, anchor_state.type)
 
         self.state_cache = StateCache()
+        from .archiver import DEFAULT_ARCHIVE_STATE_EPOCH_FREQUENCY, Archiver
+        from .regen import QueuedStateRegenerator
+
+        self.regen = QueuedStateRegenerator(self)
+        self.archiver = Archiver(
+            self,
+            db,
+            DEFAULT_ARCHIVE_STATE_EPOCH_FREQUENCY
+            if archive_state_epoch_frequency is None
+            else archive_state_epoch_frequency,
+        )
         self.attestation_pool = AttestationPool()
         self.aggregated_attestation_pool = AggregatedAttestationPool()
         self.op_pool = OpPool()
@@ -188,10 +200,11 @@ class BeaconChain:
     # -- block store -----------------------------------------------------------
 
     def get_block_by_root(self, block_root: bytes):
-        """Fork-aware decode from the hot block db."""
+        """Fork-aware decode from the hot block db, falling through to
+        the finalized archive (root index -> slot -> cold bucket)."""
         raw = self.blocks_db.get_binary(block_root)
         if raw is None:
-            return None
+            return self.archiver.get_archived_block_by_root(block_root)
         node = self.fork_choice.proto_array.get_block(_hex(block_root))
         slot = node.slot if node is not None else 0
         _, signed_type = self.block_type_at_slot(slot)
@@ -371,14 +384,17 @@ class BeaconChain:
             return block_root
 
     def _on_finalized(self, cp) -> None:
-        """Archive + prune on finalization (reference `archiver/`)."""
+        """Archive then prune on finalization (reference `archiver/`):
+        block/state migration runs while the dead-fork nodes are still
+        in the proto array, then fork choice + caches are pruned."""
         root = bytes(cp.root)
+        self.archiver.on_finalized(cp)
         self.fork_choice.prune()
         keep = {bytes.fromhex(n.block_root[2:]) for n in self.fork_choice.proto_array.nodes}
         self.state_cache.prune_except(keep)
+        self.regen.prune_on_finalized(cp.epoch)
         st = self.state_cache.get(root)
         if st is not None:
-            self.states_db.put(root, st)
             self.op_pool.prune_all(st)
         self._emit("finalized", cp)
 
@@ -390,3 +406,18 @@ class BeaconChain:
 
     def get_head_state(self):
         return self.get_state_by_block_root(self.head_root)
+
+    def get_finalized_state(self):
+        """State at the finalized checkpoint: hot cache, else regen from
+        the finalized block (still in fork choice), else the newest
+        archived state at or before the finalized slot."""
+        root = bytes.fromhex(self.fork_choice.finalized.root[2:])
+        st = self.state_cache.get(root)
+        if st is not None:
+            return st
+        try:
+            return self.get_state_by_block_root(root)
+        except BlockError:
+            pass
+        finalized_slot = self.fork_choice.finalized.epoch * self.p.SLOTS_PER_EPOCH
+        return self.archiver.get_archived_state_at_or_before(finalized_slot)

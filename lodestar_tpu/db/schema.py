@@ -20,7 +20,7 @@ _UINT_LEN = 8
 
 class Bucket(enum.IntEnum):
     # beacon chain
-    allForks_stateArchive = 0  # Root -> BeaconState
+    allForks_stateArchive = 0  # Slot -> BeaconState (Root->Slot in index_stateArchiveRootIndex)
     allForks_block = 1  # Root -> SignedBeaconBlock
     allForks_blockArchive = 2  # Slot -> SignedBeaconBlock
     index_blockArchiveParentRootIndex = 3  # parent Root -> Slot

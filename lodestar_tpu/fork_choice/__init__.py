@@ -200,3 +200,24 @@ class ForkChoice:
 
     def prune(self) -> list[ProtoNode]:
         return self.proto_array.maybe_prune(self.finalized.root)
+
+    def get_all_ancestor_blocks(self, block_root: str) -> list[ProtoNode]:
+        """The canonical chain ending at `block_root` (inclusive),
+        ascending by slot — the blocks the archiver migrates to the cold
+        db (reference forkChoice.getAllAncestorBlocks)."""
+        pa = self.proto_array
+        idx = pa.indices.get(block_root)
+        out: list[ProtoNode] = []
+        while idx is not None:
+            node = pa.nodes[idx]
+            out.append(node)
+            idx = node.parent
+        out.reverse()
+        return out
+
+    def get_all_non_ancestor_blocks(self, block_root: str) -> list[ProtoNode]:
+        """Every known block NOT on the canonical chain to `block_root`
+        — dead forks the archiver deletes from the hot db (reference
+        forkChoice.getAllNonAncestorBlocks)."""
+        canonical = {n.block_root for n in self.get_all_ancestor_blocks(block_root)}
+        return [n for n in self.proto_array.nodes if n.block_root not in canonical]

@@ -140,18 +140,51 @@ def process_execution_payload(
 # --- fork upgrade -------------------------------------------------------------
 
 
+def carry_state_upgrade(
+    pre,
+    cfg,
+    p: BeaconPreset,
+    *,
+    src_fork: str,
+    dst_fork: str,
+    fallback_version: bytes,
+    skip: tuple[str, ...] = (),
+    carry_header: bool = False,
+):
+    """Shared spec-upgrade shape: copy the source fork's state fields,
+    rotate Fork versions, and (optionally) re-type the execution payload
+    header field-by-field, leaving new header fields at default. Each
+    per-fork upgrade_to_* wraps this (reference `slot/upgradeStateTo*.ts`
+    all follow this same carry-over pattern)."""
+    t = ssz_types(p)
+    post = getattr(t, dst_fork).BeaconState.default()
+    all_skip = set(skip) | ({"latest_execution_payload_header"} if carry_header else set())
+    for fname, _ in getattr(t, src_fork).BeaconState.fields:
+        if fname in all_skip:
+            continue
+        setattr(post, fname, getattr(pre, fname))
+    fork = t.Fork.default()
+    fork.previous_version = bytes(pre.fork.current_version)
+    fork.current_version = (
+        getattr(cfg, f"{dst_fork.upper()}_FORK_VERSION") if cfg else fallback_version
+    )
+    fork.epoch = get_current_epoch(pre)
+    post.fork = fork
+    if carry_header:
+        header = getattr(t, dst_fork).ExecutionPayloadHeader.default()
+        for fname, _ in getattr(t, src_fork).ExecutionPayloadHeader.fields:
+            setattr(header, fname, getattr(pre.latest_execution_payload_header, fname))
+        post.latest_execution_payload_header = header
+    return post
+
+
 def upgrade_to_bellatrix(pre, cfg, p: BeaconPreset):
     """Spec upgrade_to_bellatrix: altair fields carry over; the execution
     header starts at its default (reference
     `slot/upgradeStateToBellatrix.ts`)."""
     t = ssz_types(p)
-    post = t.bellatrix.BeaconState.default()
-    for fname, _ in t.altair.BeaconState.fields:
-        setattr(post, fname, getattr(pre, fname))
-    fork = t.Fork.default()
-    fork.previous_version = bytes(pre.fork.current_version)
-    fork.current_version = cfg.BELLATRIX_FORK_VERSION if cfg else b"\x02\x00\x00\x00"
-    fork.epoch = get_current_epoch(pre)
-    post.fork = fork
+    post = carry_state_upgrade(
+        pre, cfg, p, src_fork="altair", dst_fork="bellatrix", fallback_version=b"\x02\x00\x00\x00"
+    )
     post.latest_execution_payload_header = t.bellatrix.ExecutionPayloadHeader.default()
     return post

@@ -181,22 +181,17 @@ def upgrade_to_capella(pre, cfg, p: BeaconPreset):
     """Spec upgrade_to_capella: bellatrix fields carry over; the payload
     header is extended with a zero withdrawals_root; withdrawal sweep
     counters start at 0 (reference `slot/upgradeStateToCapella.ts`)."""
-    t = ssz_types(p)
-    post = t.capella.BeaconState.default()
-    for fname, _ in t.bellatrix.BeaconState.fields:
-        if fname == "latest_execution_payload_header":
-            continue
-        setattr(post, fname, getattr(pre, fname))
-    fork = t.Fork.default()
-    fork.previous_version = bytes(pre.fork.current_version)
-    fork.current_version = cfg.CAPELLA_FORK_VERSION if cfg else b"\x03\x00\x00\x00"
-    fork.epoch = get_current_epoch(pre)
-    post.fork = fork
-    old = pre.latest_execution_payload_header
-    header = t.capella.ExecutionPayloadHeader.default()
-    for fname, _ in t.bellatrix.ExecutionPayloadHeader.fields:
-        setattr(header, fname, getattr(old, fname))
-    post.latest_execution_payload_header = header  # withdrawals_root stays zero
+    from .bellatrix import carry_state_upgrade
+
+    post = carry_state_upgrade(
+        pre,
+        cfg,
+        p,
+        src_fork="bellatrix",
+        dst_fork="capella",
+        fallback_version=b"\x03\x00\x00\x00",
+        carry_header=True,  # withdrawals_root stays zero
+    )
     post.next_withdrawal_index = 0
     post.next_withdrawal_validator_index = 0
     return post

@@ -13,10 +13,8 @@ from __future__ import annotations
 import hashlib
 
 from lodestar_tpu.params import BeaconPreset
-from lodestar_tpu.types import ssz_types
 
 from .block import BlockProcessError
-from .util import get_current_epoch
 
 __all__ = [
     "BLOB_TX_TYPE",
@@ -96,20 +94,14 @@ def upgrade_to_deneb(pre, cfg, p: BeaconPreset):
     """Spec (early-4844) upgrade_to_deneb: capella fields carry over; the
     payload header gains excess_data_gas=0 (reference
     `slot/upgradeStateToDeneb.ts`)."""
-    t = ssz_types(p)
-    post = t.deneb.BeaconState.default()
-    for fname, _ in t.capella.BeaconState.fields:
-        if fname == "latest_execution_payload_header":
-            continue
-        setattr(post, fname, getattr(pre, fname))
-    fork = t.Fork.default()
-    fork.previous_version = bytes(pre.fork.current_version)
-    fork.current_version = cfg.DENEB_FORK_VERSION if cfg else b"\x04\x00\x00\x00"
-    fork.epoch = get_current_epoch(pre)
-    post.fork = fork
-    old = pre.latest_execution_payload_header
-    header = t.deneb.ExecutionPayloadHeader.default()
-    for fname, _ in t.capella.ExecutionPayloadHeader.fields:
-        setattr(header, fname, getattr(old, fname))
-    post.latest_execution_payload_header = header  # excess_data_gas stays 0
-    return post
+    from .bellatrix import carry_state_upgrade
+
+    return carry_state_upgrade(
+        pre,
+        cfg,
+        p,
+        src_fork="capella",
+        dst_fork="deneb",
+        fallback_version=b"\x04\x00\x00\x00",
+        carry_header=True,  # excess_data_gas stays 0
+    )

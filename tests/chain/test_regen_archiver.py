@@ -1,0 +1,260 @@
+"""QueuedStateRegenerator, CheckpointStateCache, JobItemQueue, Archiver.
+
+Reference behaviors pinned: regen admission threshold (queued.ts:52),
+FIFO-reject/LIFO-drop queue policies (itemQueue.ts), checkpoint-cache
+epoch pruning (stateContextCheckpointsCache.ts:105), finalized block
+migration hot->cold with root indexes and dead-fork deletion
+(archiveBlocks.ts)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.regen import (
+    REGEN_CAN_ACCEPT_WORK_THRESHOLD,
+    CheckpointStateCache,
+)
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.types import ssz_types
+from lodestar_tpu.utils.queue import JobItemQueue, QueueError, QueueType
+
+from ..state_transition.test_state_transition import _empty_block_at
+
+N = 32
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+@pytest.fixture(scope="module")
+def sks():
+    return interop_secret_keys(N)
+
+
+def _chain(genesis, slot=1, **kw):
+    return BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=slot,
+        **kw,
+    )
+
+
+def _blocks(genesis, sks, p, n, start=1):
+    from lodestar_tpu.state_transition import state_transition
+
+    blocks, state = [], genesis
+    for slot in range(start, start + n):
+        signed = _empty_block_at(state, slot, sks, p)
+        blocks.append(signed)
+        state = state_transition(state, signed, p, verify_signatures=False,
+                                 verify_proposer_signature=False)
+    return blocks
+
+
+# --- JobItemQueue -------------------------------------------------------------
+
+
+def test_queue_fifo_runs_in_order_and_rejects_overflow():
+    ran = []
+
+    async def go():
+        gate = asyncio.Event()
+
+        async def job(i):
+            await gate.wait()
+            ran.append(i)
+            return i * 10
+
+        q = JobItemQueue(job, max_length=3)
+        tasks = [asyncio.ensure_future(q.push(i)) for i in range(3)]
+        await asyncio.sleep(0)  # all three enqueued = full
+        with pytest.raises(QueueError):
+            await q.push(99)
+        gate.set()
+        return await asyncio.gather(*tasks)
+
+    results = asyncio.run(go())
+    assert results == [0, 10, 20]
+    assert ran == [0, 1, 2]
+    assert 99 not in ran
+
+
+def test_queue_lifo_drops_oldest_and_serves_newest_first():
+    ran = []
+
+    async def go():
+        gate = asyncio.Event()
+
+        async def job(i):
+            await gate.wait()
+            ran.append(i)
+            return i
+
+        q = JobItemQueue(job, max_length=2, queue_type=QueueType.LIFO)
+        t0 = asyncio.ensure_future(q.push(0))
+        for _ in range(3):  # let the runner pop job 0 and block on the gate
+            await asyncio.sleep(0)
+        assert q.job_len == 1  # 0 running, nothing queued
+        t1 = asyncio.ensure_future(q.push(1))
+        t2 = asyncio.ensure_future(q.push(2))
+        await asyncio.sleep(0)  # queue = [1, 2], full
+        t3 = asyncio.ensure_future(q.push(3))  # drops oldest queued (1)
+        gate.set()
+        await asyncio.gather(t0, t2, t3)
+        with pytest.raises(QueueError):
+            await t1
+
+    asyncio.run(go())
+    assert 1 not in ran
+    # newest-first service among the queued jobs
+    assert ran.index(3) < ran.index(2)
+
+
+def test_queue_propagates_job_exception_and_keeps_draining():
+    def job(i):
+        if i == 1:
+            raise ValueError("boom")
+        return i
+
+    q = JobItemQueue(job, max_length=10)
+
+    async def go():
+        t = [asyncio.ensure_future(q.push(i)) for i in range(3)]
+        res = await asyncio.gather(*t, return_exceptions=True)
+        return res
+
+    r = asyncio.run(go())
+    assert r[0] == 0 and r[2] == 2
+    assert isinstance(r[1], ValueError)
+
+
+# --- CheckpointStateCache -----------------------------------------------------
+
+
+def test_checkpoint_cache_prunes_old_epochs():
+    c = CheckpointStateCache(max_epochs=3)
+    for e in range(6):
+        c.add(e, b"\x01" * 32, f"state{e}")
+    assert c.get(0, b"\x01" * 32) is None
+    assert c.get(5, b"\x01" * 32) == "state5"
+    assert len(c) == 3
+    c.prune_finalized(5)
+    assert len(c) == 1
+    assert c.get_latest(b"\x01" * 32, max_epoch=10) == "state5"
+
+
+# --- QueuedStateRegenerator ---------------------------------------------------
+
+
+def test_regen_get_state_and_checkpoint_state(minimal_preset, sks):
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p)
+    t = ssz_types(p)
+    chain = _chain(genesis, slot=3)
+    blocks = _blocks(genesis, sks, p, 3)
+
+    async def go():
+        for b in blocks:
+            await chain.process_block(b)
+        root = t.phase0.BeaconBlock.hash_tree_root(blocks[-1].message)
+        # cache hit path
+        st = await chain.regen.get_state(root)
+        assert st.slot == 3
+        # evict the head state only and force replay through the queue
+        chain.state_cache._by_root.pop(root, None)
+        st2 = await chain.regen.get_state(root)
+        assert st2.type.hash_tree_root(st2) == st.type.hash_tree_root(st)
+        # checkpoint state: epoch 1 start-slot state of the head block
+        cp_state = await chain.regen.get_checkpoint_state(1, root)
+        assert cp_state.slot == p.SLOTS_PER_EPOCH
+        # now cached
+        assert chain.regen.get_checkpoint_state_sync(1, root) is cp_state
+        assert chain.regen.can_accept_work()
+        assert chain.regen.job_len < REGEN_CAN_ACCEPT_WORK_THRESHOLD
+
+    asyncio.run(go())
+
+
+def test_regen_get_pre_state_dials_to_block_slot(minimal_preset, sks):
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p)
+    t = ssz_types(p)
+    chain = _chain(genesis, slot=6)
+    blocks = _blocks(genesis, sks, p, 2)
+
+    async def go():
+        for b in blocks:
+            await chain.process_block(b)
+        # a hypothetical block at slot 6 on top of block 2
+        parent_root = t.phase0.BeaconBlock.hash_tree_root(blocks[-1].message)
+        fake = t.phase0.BeaconBlock.default()
+        fake.slot = 6
+        fake.parent_root = parent_root
+        pre = await chain.regen.get_pre_state(fake)
+        assert pre.slot == 6
+
+    asyncio.run(go())
+
+
+# --- Archiver -----------------------------------------------------------------
+
+
+def test_archiver_migrates_finalized_blocks(minimal_preset, sks):
+    """Drive the archiver directly with a fake finalized checkpoint over
+    an imported chain: canonical blocks move to the cold bucket with
+    root indexes, and hot lookups fall through to the archive."""
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p)
+    t = ssz_types(p)
+    chain = _chain(genesis, slot=p.SLOTS_PER_EPOCH + 1, archive_state_epoch_frequency=0)
+    blocks = _blocks(genesis, sks, p, p.SLOTS_PER_EPOCH)
+
+    async def go():
+        for b in blocks:
+            await chain.process_block(b)
+
+    asyncio.run(go())
+
+    root_1 = t.phase0.BeaconBlock.hash_tree_root(blocks[0].message)
+    head = chain.head_root
+
+    class _CP:
+        epoch = 1
+        root = head
+
+    chain.archiver.on_finalized(_CP())
+
+    # hot bucket no longer holds the canonical chain...
+    assert chain.blocks_db.get_binary(root_1) is None
+    # ...but by-root lookup falls through to the archive
+    got = chain.get_block_by_root(root_1)
+    assert t.phase0.BeaconBlock.hash_tree_root(got.message) == root_1
+    # by-slot cold lookup
+    got2 = chain.archiver.get_archived_block_by_slot(int(blocks[0].message.slot))
+    assert t.phase0.SignedBeaconBlock.serialize(got2) == t.phase0.SignedBeaconBlock.serialize(
+        blocks[0]
+    )
+    # finalized state archived at its slot, readable back fork-aware
+    st = chain.state_cache.get(head)
+    archived = chain.archiver.get_archived_state_by_slot(int(st.slot))
+    assert archived is not None and archived.type.hash_tree_root(archived) == st.type.hash_tree_root(st)
+    assert chain.archiver.get_archived_state_at_or_before(10**6).slot == st.slot
+    by_root = chain.archiver.get_archived_state_by_root(st.type.hash_tree_root(st))
+    assert by_root is not None and by_root.slot == st.slot
+    # API "finalized" fallback resolves even after hot-cache eviction
+    chain.state_cache._by_root.pop(head, None)
+    fin = chain.get_finalized_state()
+    assert fin is not None and int(fin.slot) <= int(st.slot) + 1
