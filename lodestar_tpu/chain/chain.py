@@ -126,6 +126,16 @@ class BeaconChain:
         self.attestation_pool = AttestationPool()
         self.aggregated_attestation_pool = AggregatedAttestationPool()
         self.op_pool = OpPool()
+        from .sync_pools import (
+            SeenSlotKeyed,
+            SyncCommitteeMessagePool,
+            SyncContributionAndProofPool,
+        )
+
+        self.sync_committee_message_pool = SyncCommitteeMessagePool(p)
+        self.sync_contribution_pool = SyncContributionAndProofPool(p)
+        self.seen_sync_messages = SeenSlotKeyed()
+        self.seen_sync_aggregators = SeenSlotKeyed()
         # optional eth1 provider for block production (execution.eth1)
         self.eth1 = None
         # optional light-client server (chain.light_client_server)
@@ -196,6 +206,10 @@ class BeaconChain:
         self.fork_choice.on_tick(slot)
         self.attestation_pool.prune(slot)
         self.aggregated_attestation_pool.prune(slot)
+        self.sync_committee_message_pool.prune(slot)
+        self.sync_contribution_pool.prune(slot)
+        self.seen_sync_messages.prune(slot - 3)
+        self.seen_sync_aggregators.prune(slot - 3)
 
     # -- block store -----------------------------------------------------------
 
@@ -409,8 +423,9 @@ class BeaconChain:
 
     def get_finalized_state(self):
         """State at the finalized checkpoint: hot cache, else regen from
-        the finalized block (still in fork choice), else the newest
-        archived state at or before the finalized slot."""
+        the finalized block (still in fork choice), else replay the
+        archived canonical blocks forward from the newest archived state
+        — never a silently-stale snapshot."""
         root = bytes.fromhex(self.fork_choice.finalized.root[2:])
         st = self.state_cache.get(root)
         if st is not None:
@@ -419,5 +434,19 @@ class BeaconChain:
             return self.get_state_by_block_root(root)
         except BlockError:
             pass
-        finalized_slot = self.fork_choice.finalized.epoch * self.p.SLOTS_PER_EPOCH
-        return self.archiver.get_archived_state_at_or_before(finalized_slot)
+        node = self.fork_choice.proto_array.get_block(self.fork_choice.finalized.root)
+        finalized_slot = (
+            node.slot if node is not None else self.fork_choice.finalized.epoch * self.p.SLOTS_PER_EPOCH
+        )
+        st = self.archiver.get_archived_state_at_or_before(finalized_slot)
+        if st is None:
+            return None
+        for slot in range(int(st.slot) + 1, finalized_slot + 1):
+            signed = self.archiver.get_archived_block_by_slot(slot)
+            if signed is not None:
+                st = self._replay_block(st, signed)
+        if int(st.slot) < finalized_slot:
+            st = st.copy()
+            process_slots(st, finalized_slot, self.p, self.cfg)
+        self.state_cache.add(root, st)
+        return st

@@ -41,6 +41,8 @@ class InsertOutcome(enum.Enum):
     AGGREGATED = "Aggregated"
     ALREADY_KNOWN = "AlreadyKnown"
     OLD = "Old"
+    REACHED_MAX_PER_SLOT = "ReachedMaxPerSlot"
+    NOT_BETTER_THAN = "NotBetterThan"
 
 
 class OpPoolError(Exception):

@@ -88,9 +88,12 @@ def produce_block(
     from lodestar_tpu.state_transition.block import fork_of
 
     if fork_of(work) != "phase0":
-        # empty sync aggregate must carry the G2 infinity signature (the
-        # eth2 convention eth_fast_aggregate_verify accepts for no bits)
-        body.sync_aggregate.sync_committee_signature = bytes([0xC0]) + bytes(95)
+        # sync aggregate over the parent root from the contribution pool;
+        # with no contributions this yields empty bits + the G2 infinity
+        # signature (the eth_fast_aggregate_verify empty-participation case)
+        body.sync_aggregate = chain.sync_contribution_pool.get_sync_aggregate(
+            slot - 1, bytes(head_root)
+        )
 
     att_slashings, prop_slashings, exits = chain.op_pool.get_slashings_and_exits(work, p)
     body.proposer_slashings = prop_slashings

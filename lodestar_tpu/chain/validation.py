@@ -223,3 +223,184 @@ def validate_gossip_block(chain, signed_block) -> None:
     block_root = t.phase0.BeaconBlock.hash_tree_root(block)
     if chain.fork_choice.proto_array.has_block("0x" + block_root.hex()):
         raise GossipValidationError(GossipAction.IGNORE, "already known")
+
+
+# --- sync committee topics ----------------------------------------------------
+# Reference `validation/syncCommittee.ts` (sync_committee_{subnet_id}) and
+# `validation/syncCommitteeContributionAndProof.ts`.
+
+
+@dataclass
+class SyncCommitteeValidationResult:
+    """`register_seen` MUST be called only after the signature sets have
+    verified — marking earlier would let a garbage-signature message
+    censor the real one for the slot (the reference registers its seen
+    caches post-verification)."""
+
+    indices_in_subcommittee: list
+    signature_sets: list
+    register_seen: object  # () -> None
+
+    @property
+    def index_in_subcommittee(self) -> int:
+        return self.indices_in_subcommittee[0] if self.indices_in_subcommittee else -1
+
+
+def _sync_signing_root(block_root: bytes, domain: bytes) -> bytes:
+    # SigningData(object_root=Root, domain) root == sha256(root || domain)
+    return hashlib.sha256(bytes(block_root) + domain).digest()
+
+
+# (id(committee), subnet) -> (committee ref, pubkeys, pubkey->positions).
+# The strong committee ref keeps the id stable while the entry lives;
+# sync committees rotate once per period so a tiny cache suffices.
+_SUBCOMMITTEE_CACHE: dict = {}
+
+
+def _subcommittee_pubkeys(state, subnet: int, p) -> tuple[list[bytes], dict]:
+    from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_COUNT
+
+    committee = state.current_sync_committee
+    key = (id(committee), int(subnet))
+    hit = _SUBCOMMITTEE_CACHE.get(key)
+    if hit is not None and hit[0] is committee:
+        return hit[1], hit[2]
+    sub = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    pks = [bytes(pk) for pk in list(committee.pubkeys)[subnet * sub : (subnet + 1) * sub]]
+    positions: dict = {}
+    for i, pk in enumerate(pks):  # sampled with replacement: dup positions
+        positions.setdefault(pk, []).append(i)
+    if len(_SUBCOMMITTEE_CACHE) > 64:
+        _SUBCOMMITTEE_CACHE.clear()
+    _SUBCOMMITTEE_CACHE[key] = (committee, pks, positions)
+    return pks, positions
+
+
+def validate_sync_committee_message(chain, message, subnet: int) -> SyncCommitteeValidationResult:
+    """sync_committee_{subnet} topic checks; returns the signature set
+    for the batched verifier plus the subcommittee position needed by
+    the message pool."""
+    p = chain.p
+    slot = int(message.slot)
+    current_slot = chain.fork_choice.current_slot
+    # [IGNORE] message for the current slot (+- one slot of disparity)
+    if not (current_slot - 1 <= slot <= current_slot + 1):
+        raise GossipValidationError(GossipAction.IGNORE, "not current slot")
+
+    state = chain.get_head_state()
+    vi = int(message.validator_index)
+    if vi >= len(state.validators):
+        raise GossipValidationError(GossipAction.REJECT, "unknown validator index")
+    pubkey = bytes(state.validators[vi].pubkey)
+    _sub_pks, positions = _subcommittee_pubkeys(state, subnet, p)
+    indices = positions.get(pubkey)
+    if not indices:
+        raise GossipValidationError(GossipAction.REJECT, "validator not in subcommittee")
+
+    # [IGNORE] first message per (slot, validator, subnet)
+    if chain.seen_sync_messages.is_known(slot, vi, subnet):
+        raise GossipValidationError(GossipAction.IGNORE, "already seen sync message")
+
+    from lodestar_tpu.params import DOMAIN_SYNC_COMMITTEE
+
+    epoch = slot // p.SLOTS_PER_EPOCH
+    domain = get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch)
+    sig_set = SignatureSet(
+        pubkey=pubkey,
+        message=_sync_signing_root(bytes(message.beacon_block_root), domain),
+        signature=bytes(message.signature),
+    )
+    return SyncCommitteeValidationResult(
+        indices_in_subcommittee=list(indices),
+        signature_sets=[sig_set],
+        register_seen=lambda: chain.seen_sync_messages.add(slot, vi, subnet),
+    )
+
+
+def is_sync_committee_aggregator(selection_proof: bytes, p) -> bool:
+    """Spec is_sync_committee_aggregator (reference
+    `state-transition/src/util/aggregator.ts isSyncCommitteeAggregator`)."""
+    from lodestar_tpu.params import (
+        SYNC_COMMITTEE_SUBNET_COUNT,
+        TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+
+    modulo = max(
+        1,
+        p.SYNC_COMMITTEE_SIZE
+        // SYNC_COMMITTEE_SUBNET_COUNT
+        // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+    h = hashlib.sha256(bytes(selection_proof)).digest()
+    return int.from_bytes(h[:8], "little") % modulo == 0
+
+
+def validate_sync_committee_contribution(chain, signed) -> SyncCommitteeValidationResult:
+    """sync_committee_contribution_and_proof topic checks; returns three
+    signature sets (selection proof, outer signature, aggregate
+    contribution)."""
+    from lodestar_tpu.crypto.bls.api import aggregate_pubkeys
+    from lodestar_tpu.params import (
+        DOMAIN_CONTRIBUTION_AND_PROOF,
+        DOMAIN_SYNC_COMMITTEE,
+        DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        SYNC_COMMITTEE_SUBNET_COUNT,
+    )
+
+    p = chain.p
+    t = ssz_types(p)
+    cp = signed.message
+    contribution = cp.contribution
+    slot = int(contribution.slot)
+    subnet = int(contribution.subcommittee_index)
+    current_slot = chain.fork_choice.current_slot
+
+    if not (current_slot - 1 <= slot <= current_slot + 1):
+        raise GossipValidationError(GossipAction.IGNORE, "not current slot")
+    if subnet >= SYNC_COMMITTEE_SUBNET_COUNT:
+        raise GossipValidationError(GossipAction.REJECT, "bad subcommittee index")
+    bits = list(contribution.aggregation_bits)
+    if not any(bits):
+        raise GossipValidationError(GossipAction.REJECT, "empty contribution")
+    if not is_sync_committee_aggregator(bytes(cp.selection_proof), p):
+        raise GossipValidationError(GossipAction.REJECT, "selection proof not aggregator")
+
+    state = chain.get_head_state()
+    ai = int(cp.aggregator_index)
+    if ai >= len(state.validators):
+        raise GossipValidationError(GossipAction.REJECT, "unknown aggregator index")
+    agg_pubkey = bytes(state.validators[ai].pubkey)
+    sub_pks, positions = _subcommittee_pubkeys(state, subnet, p)
+    if agg_pubkey not in positions:
+        raise GossipValidationError(GossipAction.REJECT, "aggregator not in subcommittee")
+    if chain.seen_sync_aggregators.is_known(slot, ai, subnet):
+        raise GossipValidationError(GossipAction.IGNORE, "already seen contribution aggregator")
+
+    epoch = slot // p.SLOTS_PER_EPOCH
+    sel_data = t.SyncAggregatorSelectionData.default()
+    sel_data.slot = slot
+    sel_data.subcommittee_index = subnet
+    sel_domain = get_domain(state, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch)
+    selection_set = SignatureSet(
+        pubkey=agg_pubkey,
+        message=compute_signing_root(t.SyncAggregatorSelectionData, sel_data, sel_domain),
+        signature=bytes(cp.selection_proof),
+    )
+    outer_domain = get_domain(state, DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+    outer_set = SignatureSet(
+        pubkey=agg_pubkey,
+        message=compute_signing_root(t.ContributionAndProof, cp, outer_domain),
+        signature=bytes(signed.signature),
+    )
+    participating = [sub_pks[i] for i, b in enumerate(bits) if b]
+    sync_domain = get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch)
+    contribution_set = SignatureSet(
+        pubkey=aggregate_pubkeys(participating),
+        message=_sync_signing_root(bytes(contribution.beacon_block_root), sync_domain),
+        signature=bytes(contribution.signature),
+    )
+    return SyncCommitteeValidationResult(
+        indices_in_subcommittee=[],
+        signature_sets=[selection_set, outer_set, contribution_set],
+        register_seen=lambda: chain.seen_sync_aggregators.add(slot, ai, subnet),
+    )
