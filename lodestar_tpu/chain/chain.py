@@ -448,5 +448,12 @@ class BeaconChain:
         if int(st.slot) < finalized_slot:
             st = st.copy()
             process_slots(st, finalized_slot, self.p, self.cfg)
-        self.state_cache.add(root, st)
+        # cache under the block root ONLY if the replay actually reached
+        # the finalized block — caching a padded-forward state under the
+        # root would poison regen for every descendant
+        header = st.latest_block_header.copy()
+        if bytes(header.state_root) == b"\x00" * 32:
+            header.state_root = st.type.hash_tree_root(st)
+        if self.types.BeaconBlockHeader.hash_tree_root(header) == root:
+            self.state_cache.add(root, st)
         return st

@@ -15,11 +15,11 @@ from lodestar_tpu.crypto.bls import api as bls
 from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_COUNT, BeaconPreset, active_preset
 from lodestar_tpu.types import ssz_types
 
-from .op_pools import InsertOutcome
+from .op_pools import InsertOutcome, OpPoolError
 
 __all__ = ["SyncCommitteeMessagePool", "SyncContributionAndProofPool"]
 
-G2_INFINITY = bytes([0xC0]) + bytes(95)
+G2_INFINITY = bls.G2_INFINITY
 
 MESSAGE_SLOTS_RETAINED = 3
 CONTRIBUTION_SLOTS_RETAINED = 8
@@ -60,6 +60,7 @@ class SyncCommitteeMessagePool:
         self.p = p or active_preset()
         # (slot, block_root, subnet) -> _Aggregate
         self._by_key: dict[tuple[int, bytes, int], _Aggregate] = {}
+        self._count_by_slot: dict[int, int] = {}
         self.lowest_permissible_slot = 0
 
     @property
@@ -67,15 +68,20 @@ class SyncCommitteeMessagePool:
         return self.p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
 
     def add(self, subnet: int, message, index_in_subcommittee: int) -> InsertOutcome:
+        if not (0 <= int(subnet) < SYNC_COMMITTEE_SUBNET_COUNT):
+            raise OpPoolError(f"bad subnet {subnet}")
+        if not (0 <= int(index_in_subcommittee) < self.subcommittee_size):
+            raise OpPoolError(f"bad subcommittee position {index_in_subcommittee}")
         slot = int(message.slot)
         if slot < self.lowest_permissible_slot:
             return InsertOutcome.OLD
         key = (slot, bytes(message.beacon_block_root), int(subnet))
         agg = self._by_key.get(key)
         if agg is None:
-            if sum(1 for k in self._by_key if k[0] == slot) >= MAX_ITEMS_PER_SLOT:
+            if self._count_by_slot.get(slot, 0) >= MAX_ITEMS_PER_SLOT:
                 return InsertOutcome.REACHED_MAX_PER_SLOT
             agg = self._by_key[key] = _Aggregate(self.subcommittee_size)
+            self._count_by_slot[slot] = self._count_by_slot.get(slot, 0) + 1
         return agg.add(int(index_in_subcommittee), bytes(message.signature))
 
     def get_contribution(self, subnet: int, slot: int, block_root: bytes):
@@ -97,6 +103,8 @@ class SyncCommitteeMessagePool:
         self.lowest_permissible_slot = max(0, clock_slot - MESSAGE_SLOTS_RETAINED)
         for k in [k for k in self._by_key if k[0] < self.lowest_permissible_slot]:
             del self._by_key[k]
+        for s in [s for s in self._count_by_slot if s < self.lowest_permissible_slot]:
+            del self._count_by_slot[s]
 
 
 class SyncContributionAndProofPool:
@@ -108,22 +116,31 @@ class SyncContributionAndProofPool:
         self.p = p or active_preset()
         # (slot, block_root) -> {subnet: (participants, bits, signature)}
         self._best: dict[tuple[int, bytes], dict[int, tuple[int, list[bool], bytes]]] = {}
+        self._count_by_slot: dict[int, int] = {}
         self.lowest_permissible_slot = 0
+
+    @property
+    def subcommittee_size(self) -> int:
+        return self.p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
 
     def add(self, contribution_and_proof) -> InsertOutcome:
         contribution = contribution_and_proof.contribution
+        subnet = int(contribution.subcommittee_index)
+        bits = list(contribution.aggregation_bits)
+        # reject malformed input at ingest, not in produce_block
+        if not (0 <= subnet < SYNC_COMMITTEE_SUBNET_COUNT):
+            raise OpPoolError(f"bad subcommittee index {subnet}")
+        if len(bits) != self.subcommittee_size:
+            raise OpPoolError(f"bad aggregation bits length {len(bits)}")
         slot = int(contribution.slot)
         if slot < self.lowest_permissible_slot:
             return InsertOutcome.OLD
         key = (slot, bytes(contribution.beacon_block_root))
-        if (
-            key not in self._best
-            and sum(1 for k in self._best if k[0] == slot) >= MAX_ITEMS_PER_SLOT
-        ):
-            return InsertOutcome.REACHED_MAX_PER_SLOT
+        if key not in self._best:
+            if self._count_by_slot.get(slot, 0) >= MAX_ITEMS_PER_SLOT:
+                return InsertOutcome.REACHED_MAX_PER_SLOT
+            self._count_by_slot[slot] = self._count_by_slot.get(slot, 0) + 1
         by_subnet = self._best.setdefault(key, {})
-        subnet = int(contribution.subcommittee_index)
-        bits = list(contribution.aggregation_bits)
         participants = sum(bits)
         cur = by_subnet.get(subnet)
         if cur is not None and cur[0] >= participants:
@@ -155,6 +172,8 @@ class SyncContributionAndProofPool:
         self.lowest_permissible_slot = max(0, clock_slot - CONTRIBUTION_SLOTS_RETAINED)
         for k in [k for k in self._best if k[0] < self.lowest_permissible_slot]:
             del self._best[k]
+        for s in [s for s in self._count_by_slot if s < self.lowest_permissible_slot]:
+            del self._count_by_slot[s]
 
 
 class SeenSlotKeyed:

@@ -257,10 +257,26 @@ def _sync_signing_root(block_root: bytes, domain: bytes) -> bytes:
 _SUBCOMMITTEE_CACHE: dict = {}
 
 
-def _subcommittee_pubkeys(state, subnet: int, p) -> tuple[list[bytes], dict]:
+def _committee_for_slot(state, slot: int, p):
+    """current_sync_committee, or next_ when the message's slot falls in
+    the period after the head state's — validators begin signing with
+    the new committee at the boundary while the head still lags a slot
+    (reference syncCommittee.ts getSyncCommitteeValidatorIndexMap uses
+    the state at the message's epoch)."""
+    period_len = p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * p.SLOTS_PER_EPOCH
+    msg_period = int(slot) // period_len
+    state_period = int(state.slot) // period_len
+    if msg_period == state_period + 1:
+        return state.next_sync_committee
+    return state.current_sync_committee
+
+
+def _subcommittee_pubkeys(state, subnet: int, p, slot: int | None = None) -> tuple[list[bytes], dict]:
     from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_COUNT
 
-    committee = state.current_sync_committee
+    committee = (
+        _committee_for_slot(state, slot, p) if slot is not None else state.current_sync_committee
+    )
     key = (id(committee), int(subnet))
     hit = _SUBCOMMITTEE_CACHE.get(key)
     if hit is not None and hit[0] is committee:
@@ -292,7 +308,7 @@ def validate_sync_committee_message(chain, message, subnet: int) -> SyncCommitte
     if vi >= len(state.validators):
         raise GossipValidationError(GossipAction.REJECT, "unknown validator index")
     pubkey = bytes(state.validators[vi].pubkey)
-    _sub_pks, positions = _subcommittee_pubkeys(state, subnet, p)
+    _sub_pks, positions = _subcommittee_pubkeys(state, subnet, p, slot)
     indices = positions.get(pubkey)
     if not indices:
         raise GossipValidationError(GossipAction.REJECT, "validator not in subcommittee")
@@ -370,7 +386,7 @@ def validate_sync_committee_contribution(chain, signed) -> SyncCommitteeValidati
     if ai >= len(state.validators):
         raise GossipValidationError(GossipAction.REJECT, "unknown aggregator index")
     agg_pubkey = bytes(state.validators[ai].pubkey)
-    sub_pks, positions = _subcommittee_pubkeys(state, subnet, p)
+    sub_pks, positions = _subcommittee_pubkeys(state, subnet, p, slot)
     if agg_pubkey not in positions:
         raise GossipValidationError(GossipAction.REJECT, "aggregator not in subcommittee")
     if chain.seen_sync_aggregators.is_known(slot, ai, subnet):

@@ -1,0 +1,115 @@
+"""Native (C++) host components, loaded via ctypes.
+
+The reference leans on native packages for its host hot loops
+(@chainsafe/as-sha256 WASM, leveldown C++ — SURVEY §2b); this package is
+the tpu-framework equivalent: small C++ kernels compiled on first use
+with the baked-in toolchain and bound through ctypes (no pybind11 in the
+image). Everything degrades gracefully — if the toolchain or the build
+is unavailable, consumers fall back to the pure-Python paths.
+
+Current components:
+* sha256_batch — batched pair-hashing for sub-device merkle levels
+  (SHA-NI when the CPU has it, portable scalar otherwise, threaded for
+  large batches). Consumed by `lodestar_tpu.ssz.hash.hash_nodes_cpu`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["sha256_available", "sha256_backend", "hash_pairs", "load_sha256"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "sha256_batch.cpp")
+_SO = os.path.join(_DIR, "libsha256batch.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    """Compile the shared lib if missing or stale. Returns success."""
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return True
+        cmd = [
+            "g++",
+            "-O3",
+            "-std=c++17",
+            "-fPIC",
+            "-shared",
+            "-pthread",
+            _SRC,
+            "-o",
+            _SO + ".tmp",
+        ]
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        if res.returncode != 0:
+            return False
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load_sha256():
+    """The loaded ctypes lib, or None if build/load failed (cached)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.sha256_pairs.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.sha256_pairs.restype = None
+            lib.sha256_backend.argtypes = []
+            lib.sha256_backend.restype = ctypes.c_int
+            _lib = lib
+        except OSError:
+            _load_failed = True
+            return None
+    return _lib
+
+
+def sha256_available() -> bool:
+    return load_sha256() is not None
+
+
+def sha256_backend() -> str:
+    """'shani' | 'scalar' | 'unavailable'."""
+    lib = load_sha256()
+    if lib is None:
+        return "unavailable"
+    return "shani" if lib.sha256_backend() == 1 else "scalar"
+
+
+def hash_pairs(data: np.ndarray) -> np.ndarray:
+    """SHA-256 of adjacent 32-byte node pairs. data: (2N, 32) uint8 ->
+    (N, 32) uint8. Caller must have checked sha256_available()."""
+    lib = load_sha256()
+    n = data.shape[0] // 2
+    src = np.ascontiguousarray(data[: 2 * n], dtype=np.uint8)
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.sha256_pairs(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_uint64(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out

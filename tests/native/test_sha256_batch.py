@@ -1,0 +1,63 @@
+"""Native C++ batch SHA-256: differential vs hashlib, edge sizes, and
+the ssz.hash integration (hash_nodes_cpu must produce identical
+merkle levels with or without the native backend)."""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lodestar_tpu import native
+from lodestar_tpu.ssz import hash as ssz_hash
+
+pytestmark = pytest.mark.skipif(
+    not native.sha256_available(), reason="native toolchain unavailable"
+)
+
+
+def _ref_pairs(data: np.ndarray) -> bytes:
+    n = data.shape[0] // 2
+    buf = data.tobytes()
+    return b"".join(hashlib.sha256(buf[i * 64 : (i + 1) * 64]).digest() for i in range(n))
+
+
+def test_backend_reports():
+    assert native.sha256_backend() in ("shani", "scalar")
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 64, 1000, 20000])
+def test_differential_vs_hashlib(n):
+    rng = np.random.default_rng(n)
+    data = rng.integers(0, 256, size=(2 * n, 32), dtype=np.uint8)
+    assert native.hash_pairs(data).tobytes() == _ref_pairs(data)
+
+
+def test_structured_inputs():
+    # all-zero and all-ff nodes (merkle zero-ladder inputs)
+    for fill in (0, 0xFF):
+        data = np.full((8, 32), fill, dtype=np.uint8)
+        assert native.hash_pairs(data).tobytes() == _ref_pairs(data)
+    # the zero-hash ladder itself
+    z = hashlib.sha256(b"\x00" * 64).digest()
+    data = np.frombuffer(z + z, dtype=np.uint8).reshape(2, 32)
+    assert native.hash_pairs(data).tobytes() == hashlib.sha256(z + z).digest()
+
+
+def test_non_contiguous_input():
+    rng = np.random.default_rng(5)
+    big = rng.integers(0, 256, size=(20, 64), dtype=np.uint8)
+    view = big[::2, :32]  # strided, non-contiguous
+    data = np.ascontiguousarray(view)
+    assert native.hash_pairs(view).tobytes() == _ref_pairs(data)
+
+
+def test_hash_nodes_cpu_uses_native_and_matches():
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, size=(512, 32), dtype=np.uint8)
+    got = ssz_hash.hash_nodes_cpu(data)
+    assert got.tobytes() == _ref_pairs(data)
+    # tiny inputs (below the native cutover) also agree
+    tiny = rng.integers(0, 256, size=(2, 32), dtype=np.uint8)
+    assert ssz_hash.hash_nodes_cpu(tiny).tobytes() == _ref_pairs(tiny)
