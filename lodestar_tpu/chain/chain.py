@@ -449,11 +449,15 @@ class BeaconChain:
             st = st.copy()
             process_slots(st, finalized_slot, self.p, self.cfg)
         # cache under the block root ONLY if the replay actually reached
-        # the finalized block — caching a padded-forward state under the
-        # root would poison regen for every descendant
+        # the finalized block AND stopped at its slot — caching a
+        # padded-forward state under the root would poison regen for
+        # every descendant between the block's slot and the pad target
         header = st.latest_block_header.copy()
         if bytes(header.state_root) == b"\x00" * 32:
             header.state_root = st.type.hash_tree_root(st)
-        if self.types.BeaconBlockHeader.hash_tree_root(header) == root:
+        if (
+            int(st.slot) == int(st.latest_block_header.slot)
+            and self.types.BeaconBlockHeader.hash_tree_root(header) == root
+        ):
             self.state_cache.add(root, st)
         return st

@@ -262,12 +262,18 @@ def _committee_for_slot(state, slot: int, p):
     the period after the head state's — validators begin signing with
     the new committee at the boundary while the head still lags a slot
     (reference syncCommittee.ts getSyncCommitteeValidatorIndexMap uses
-    the state at the message's epoch)."""
+    the state at the message's epoch). A message from the PREVIOUS
+    period is unverifiable from this state (the old committee is gone)
+    — IGNORE it rather than REJECT-penalizing an honest boundary peer."""
     period_len = p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * p.SLOTS_PER_EPOCH
     msg_period = int(slot) // period_len
     state_period = int(state.slot) // period_len
     if msg_period == state_period + 1:
         return state.next_sync_committee
+    if msg_period < state_period:
+        raise GossipValidationError(
+            GossipAction.IGNORE, "message from a previous sync-committee period"
+        )
     return state.current_sync_committee
 
 

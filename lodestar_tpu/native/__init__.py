@@ -38,21 +38,19 @@ def _build() -> bool:
     try:
         if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
             return True
-        cmd = [
-            "g++",
-            "-O3",
-            "-std=c++17",
-            "-fPIC",
-            "-shared",
-            "-pthread",
-            _SRC,
-            "-o",
-            _SO + ".tmp",
-        ]
-        res = subprocess.run(cmd, capture_output=True, timeout=120)
-        if res.returncode != 0:
-            return False
-        os.replace(_SO + ".tmp", _SO)
+        # pid-unique temp target: concurrent builders (multiple node
+        # processes, pytest-xdist) must not publish each other's
+        # half-written output through the shared rename
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", _SRC, "-o", tmp]
+        try:
+            res = subprocess.run(cmd, capture_output=True, timeout=120)
+            if res.returncode != 0:
+                return False
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         return True
     except (OSError, subprocess.SubprocessError):
         return False

@@ -1,0 +1,311 @@
+"""Prover: keccak/RLP KATs, MPT proof verification against an
+independently-built trie, account/storage/code/block verification, and
+the VerifiedExecutionProvider end-to-end with a fake EL handler.
+
+The in-test trie builder is a second implementation of the MPT
+construction rules (yellow paper appendix D), so verifier and builder
+cross-check each other."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.prover import (
+    EMPTY_CODE_HASH,
+    EMPTY_TRIE_ROOT,
+    PayloadStore,
+    ProofProvider,
+    VerificationError,
+    VerifiedExecutionProvider,
+    verify_account_proof,
+    verify_block_response,
+    verify_code,
+    verify_storage_proof,
+)
+from lodestar_tpu.prover.mpt import keccak256, rlp_decode, rlp_encode, verify_mpt_proof
+from lodestar_tpu.types import ssz_types
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+# --- independent MPT builder (test oracle) ------------------------------------
+
+
+def _nibs(key: bytes) -> list[int]:
+    out = []
+    for b in key:
+        out += [b >> 4, b & 0x0F]
+    return out
+
+
+def _hp(nibs: list[int], leaf: bool) -> bytes:
+    flag = 2 if leaf else 0
+    if len(nibs) % 2:
+        arr = [flag + 1] + nibs
+    else:
+        arr = [flag, 0] + nibs
+    return bytes((arr[i] << 4) | arr[i + 1] for i in range(0, len(arr), 2))
+
+
+class _TrieBuilder:
+    def __init__(self, items: dict[bytes, bytes]):
+        self.db: dict[bytes, bytes] = {}
+        entries = [(_nibs(k), v) for k, v in sorted(items.items())]
+        root_node = self._build(entries)
+        raw = rlp_encode(root_node)
+        self.root = keccak256(raw)
+        self.db[self.root] = raw
+
+    def _build(self, entries):
+        if not entries:
+            return b""
+        if len(entries) == 1:
+            nibs, value = entries[0]
+            return [_hp(nibs, True), value]
+        # longest common prefix
+        first = entries[0][0]
+        lcp = 0
+        while all(len(n) > lcp and n[lcp] == first[lcp] for n, _ in entries):
+            lcp += 1
+        if lcp:
+            sub = self._build([(n[lcp:], v) for n, v in entries])
+            return [_hp(first[:lcp], False), self._ref(sub)]
+        branch = [b""] * 17
+        for digit in range(16):
+            group = [(n[1:], v) for n, v in entries if n and n[0] == digit]
+            if group:
+                branch[digit] = self._ref(self._build(group))
+        for n, v in entries:
+            if not n:
+                branch[16] = v
+        return branch
+
+    def _ref(self, node):
+        raw = rlp_encode(node)
+        if len(raw) < 32:
+            return node  # embedded
+        h = keccak256(raw)
+        self.db[h] = raw
+        return h
+
+    def prove(self, key: bytes) -> list[bytes]:
+        """All hashed nodes along the path (superset is fine for the
+        verifier; eth_getProof returns exactly the path nodes)."""
+        return list(self.db.values())
+
+
+# --- mpt primitives -----------------------------------------------------------
+
+
+def test_keccak_kats():
+    assert keccak256(b"") == EMPTY_CODE_HASH
+    assert (
+        keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    assert keccak256(rlp_encode(b"")) == EMPTY_TRIE_ROOT
+    # multi-block absorb
+    assert keccak256(b"q" * 500) != keccak256(b"q" * 501)
+
+
+def test_rlp_vectors_and_roundtrip():
+    assert rlp_encode(b"dog") == b"\x83dog"
+    assert rlp_encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    assert rlp_encode(b"") == b"\x80"
+    assert rlp_encode(0) == b"\x80"
+    assert rlp_encode(1024) == b"\x82\x04\x00"
+    nested = [b"cat", [b"dog", b""], b"\x01"]
+    assert rlp_decode(rlp_encode(nested)) == nested
+    from lodestar_tpu.prover.mpt import MptError
+
+    with pytest.raises(MptError):
+        rlp_decode(b"\x81\x01")  # non-canonical single byte
+    with pytest.raises(MptError):
+        rlp_decode(b"\x83do")  # short string
+
+
+def test_mpt_proof_inclusion_and_exclusion():
+    items = {keccak256(bytes([i])): rlp_encode([b"v%d" % i]) for i in range(20)}
+    trie = _TrieBuilder(items)
+    for i in range(20):
+        key = keccak256(bytes([i]))
+        assert verify_mpt_proof(trie.root, key, trie.prove(key)) == items[key]
+    # absent key -> proven exclusion (None)
+    absent = keccak256(b"absent")
+    assert verify_mpt_proof(trie.root, absent, trie.prove(absent)) is None
+    # wrong root -> MptError (missing node)
+    from lodestar_tpu.prover.mpt import MptError
+
+    with pytest.raises(MptError):
+        verify_mpt_proof(b"\x00" * 32, keccak256(bytes([0])), trie.prove(keccak256(bytes([0]))))
+
+
+# --- account / storage / code / block verification ----------------------------
+
+
+def _account_trie(accounts: dict[bytes, list]):
+    """address -> [nonce, balance, storageHash, codeHash] trie."""
+    items = {
+        keccak256(addr): rlp_encode(acct) for addr, acct in accounts.items()
+    }
+    return _TrieBuilder(items)
+
+
+def _proof_dict(trie, addr, nonce, balance, storage_hash, code_hash, storage_proof=None):
+    return {
+        "accountProof": ["0x" + n.hex() for n in trie.prove(keccak256(addr))],
+        "nonce": hex(nonce),
+        "balance": hex(balance),
+        "storageHash": "0x" + storage_hash.hex(),
+        "codeHash": "0x" + code_hash.hex(),
+        "storageProof": storage_proof or [],
+    }
+
+
+def test_account_proof_verification():
+    addr = b"\xaa" * 20
+    code = b"\x60\x00\x60\x00"
+    code_hash = keccak256(code)
+    # canonical ints: nonce 5, balance 1_000_000
+    acct = [b"\x05", (1_000_000).to_bytes(3, "big"), EMPTY_TRIE_ROOT, code_hash]
+    trie = _account_trie({addr: acct, b"\xbb" * 20: [b"\x01", b"\x02", EMPTY_TRIE_ROOT, EMPTY_CODE_HASH]})
+
+    proof = _proof_dict(trie, addr, 5, 1_000_000, EMPTY_TRIE_ROOT, code_hash)
+    assert verify_account_proof(trie.root, addr, proof)
+    # tampered balance fails
+    bad = dict(proof, balance=hex(999))
+    assert not verify_account_proof(trie.root, addr, bad)
+    # exclusion proof: absent address must claim the empty account
+    missing = b"\xcc" * 20
+    empty_proof = _proof_dict(trie, missing, 0, 0, EMPTY_TRIE_ROOT, EMPTY_CODE_HASH)
+    assert verify_account_proof(trie.root, missing, empty_proof)
+    nonempty = _proof_dict(trie, missing, 0, 7, EMPTY_TRIE_ROOT, EMPTY_CODE_HASH)
+    assert not verify_account_proof(trie.root, missing, nonempty)
+    # code matches the proven hash
+    assert verify_code("0x" + code_hash.hex(), "0x" + code.hex())
+    assert not verify_code("0x" + code_hash.hex(), "0x60ff")
+
+
+def test_storage_proof_verification():
+    slot = b"\x00" * 31 + b"\x01"
+    value = 0xDEADBEEF
+    items = {keccak256(slot): rlp_encode(value)}
+    trie = _TrieBuilder(items)
+    entry = {
+        "key": "0x" + slot.hex(),
+        "value": hex(value),
+        "proof": ["0x" + n.hex() for n in trie.prove(keccak256(slot))],
+    }
+    assert verify_storage_proof(trie.root, "0x01", entry)
+    assert not verify_storage_proof(trie.root, "0x01", dict(entry, value=hex(1)))
+    # zero-slot exclusion
+    entry0 = {"key": "0x02", "value": "0x0", "proof": entry["proof"]}
+    assert verify_storage_proof(trie.root, "0x02", entry0)
+
+
+def _payload_with(p, state_root: bytes, number: int, txs: list[bytes]):
+    t = ssz_types(p)
+    payload = t.deneb.ExecutionPayload.default()
+    payload.block_hash = keccak256(b"block%d" % number)
+    payload.parent_hash = keccak256(b"block%d" % (number - 1))
+    payload.state_root = state_root
+    payload.block_number = number
+    payload.transactions = txs
+    return payload
+
+
+def test_block_response_verification(minimal_preset):
+    p = minimal_preset
+    txs = [b"\x02rawtx1", b"\x02rawtx2"]
+    payload = _payload_with(p, b"\x11" * 32, 7, txs)
+    block = {
+        "hash": "0x" + bytes(payload.block_hash).hex(),
+        "parentHash": "0x" + bytes(payload.parent_hash).hex(),
+        "stateRoot": "0x" + bytes(payload.state_root).hex(),
+        "receiptsRoot": "0x" + bytes(payload.receipts_root).hex(),
+        "miner": "0x" + bytes(payload.fee_recipient).hex(),
+        "mixHash": "0x" + bytes(payload.prev_randao).hex(),
+        "logsBloom": "0x" + bytes(payload.logs_bloom).hex(),
+        "number": hex(7),
+        "gasLimit": "0x0",
+        "gasUsed": "0x0",
+        "timestamp": "0x0",
+        "extraData": "0x",
+        "baseFeePerGas": "0x0",
+        "transactions": ["0x" + keccak256(tx).hex() for tx in txs],
+    }
+    assert verify_block_response(payload, block)
+    assert not verify_block_response(payload, dict(block, number=hex(8)))
+    assert not verify_block_response(
+        payload, dict(block, transactions=list(reversed(block["transactions"])))
+    )
+
+
+# --- payload store + verified provider ---------------------------------------
+
+
+def test_payload_store_latest_finalized(minimal_preset):
+    p = minimal_preset
+    store = PayloadStore(max_history=2)
+    pl = [_payload_with(p, b"\x00" * 32, n, []) for n in range(1, 5)]
+    store.set(pl[0], finalized=True)
+    store.set(pl[1], finalized=True)
+    store.set(pl[3], finalized=False)
+    assert store.latest is pl[3]
+    assert store.finalized is pl[1]
+    assert store.get(2) is pl[1]
+    assert store.get("0x" + bytes(pl[3].block_hash).hex()) is pl[3]
+    store.set(pl[2], finalized=True)  # prunes finalized #1
+    assert store.get(1) is None
+
+
+def test_verified_provider_end_to_end(minimal_preset):
+    p = minimal_preset
+    addr = "0x" + "aa" * 20
+    code = b"\x60\x01"
+    code_hash = keccak256(code)
+    acct = [b"\x03", b"\x64", EMPTY_TRIE_ROOT, code_hash]  # nonce 3, balance 100
+    trie = _account_trie({bytes.fromhex(addr[2:]): acct})
+
+    payload = _payload_with(p, trie.root, 10, [])
+    provider_proofs = ProofProvider()
+    provider_proofs.on_payload(payload, finalized=True)
+
+    calls = []
+
+    def handler(method, params):
+        calls.append(method)
+        if method == "eth_getProof":
+            return _proof_dict(trie, bytes.fromhex(addr[2:]), 3, 100, EMPTY_TRIE_ROOT, code_hash)
+        if method == "eth_getCode":
+            return "0x" + code.hex()
+        if method == "eth_chainId":
+            return "0x1"
+        raise AssertionError(method)
+
+    vp = VerifiedExecutionProvider(handler, provider_proofs)
+    assert int(vp.request("eth_getBalance", [addr, "latest"]), 16) == 100
+    assert int(vp.request("eth_getTransactionCount", [addr, "latest"]), 16) == 3
+    assert vp.request("eth_getCode", [addr, "latest"]) == "0x" + code.hex()
+    # unverifiable methods error out instead of passing silently
+    with pytest.raises(VerificationError):
+        vp.request("eth_call", [{"to": addr}, "latest"])
+    # non-stateful methods pass through
+    assert vp.request("eth_chainId", []) == "0x1"
+
+    # a lying EL (wrong balance in proof) is caught
+    def lying_handler(method, params):
+        if method == "eth_getProof":
+            return _proof_dict(trie, bytes.fromhex(addr[2:]), 3, 999, EMPTY_TRIE_ROOT, code_hash)
+        raise AssertionError(method)
+
+    vp2 = VerifiedExecutionProvider(lying_handler, provider_proofs)
+    with pytest.raises(VerificationError):
+        vp2.request("eth_getBalance", [addr, "latest"])
