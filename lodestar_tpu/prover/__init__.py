@@ -114,15 +114,20 @@ class PayloadStore:
         index or the canonical parent-hash chain from `latest` — never
         by scanning the payload map, which may still hold reorged-out
         payloads at the same height."""
-        if block_id in (None, "latest"):
+        if block_id in (None, "latest", "pending"):
             return self.latest
-        if block_id == "finalized":
+        if block_id in ("finalized", "safe"):
             return self.finalized
+        if block_id == "earliest":
+            return None  # genesis is outside the tracked history
         if isinstance(block_id, bytes):
             return self._payloads.get(block_id)
         if isinstance(block_id, str) and block_id.startswith("0x") and len(block_id) == 66:
             return self._payloads.get(_unhex(block_id))
-        number = _to_int(block_id)
+        try:
+            number = _to_int(block_id)
+        except (VerificationError, ValueError):
+            return None
         by_num = self._finalized_by_number.get(number)
         if by_num is not None:
             return self._payloads.get(by_num)
@@ -258,6 +263,25 @@ def verify_block_response(payload, block: dict) -> bool:
             tx_hash = tx if isinstance(tx, str) else tx.get("hash")
             if _unhex(tx_hash) != keccak256(bytes(raw)):
                 return False
+        # capella+: the withdrawals list is consensus data — every field
+        # must match the proven payload
+        if hasattr(payload, "withdrawals"):
+            wds = block.get("withdrawals", [])
+            raw_wds = list(payload.withdrawals)
+            if len(wds) != len(raw_wds):
+                return False
+            for wd, pw in zip(wds, raw_wds):
+                if (
+                    _to_int(wd["index"]) != int(pw.index)
+                    or _to_int(wd["validatorIndex"]) != int(pw.validator_index)
+                    or _unhex(wd["address"]) != bytes(pw.address)
+                    or _to_int(wd["amount"]) != int(pw.amount)
+                ):
+                    return False
+        # early-4844 deneb: one excess_data_gas quantity
+        if hasattr(payload, "excess_data_gas"):
+            if _to_int(block.get("excessDataGas", 0)) != int(payload.excess_data_gas):
+                return False
     except (KeyError, VerificationError, ValueError, TypeError, AttributeError):
         return False
     return True
@@ -345,7 +369,15 @@ class VerifiedExecutionProvider:
     def _eth_get_block(self, method: str, params: list):
         block_id = params[0]
         payload = self.proofs.get_execution_payload(block_id)
-        block = self.handler(method, params)
+        # pin the EL query to the VERIFIED payload: a tag like "latest"
+        # resolves to the light-client head, which lags the EL's own
+        # head — forwarding the tag would make honest ELs fail to verify
+        rest = list(params[1:])
+        if method == "eth_getBlockByHash":
+            el_params = [_hx(payload.block_hash), *rest]
+        else:
+            el_params = [hex(int(payload.block_number)), *rest]
+        block = self.handler(method, el_params)
         if block is None:
             return None
         if not verify_block_response(payload, block):

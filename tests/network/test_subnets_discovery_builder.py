@@ -1,0 +1,186 @@
+"""Attnets/syncnets services, metadata seq bumps, peer discovery, and
+the builder client circuit breaker + blinded flow."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.execution.builder import BuilderError, ExecutionBuilderHttp
+from lodestar_tpu.network.discovery import EnrRecord, PeerDiscovery, SubnetRequest
+from lodestar_tpu.network.subnets import (
+    EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION,
+    AttnetsService,
+    CommitteeSubscription,
+    MetadataController,
+    SyncnetsService,
+)
+from lodestar_tpu.params import ATTESTATION_SUBNET_COUNT
+from lodestar_tpu.types import ssz_types
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+class _Recorder:
+    def __init__(self):
+        self.subscribed = set()
+        self.events = []
+
+    def subscribe(self, subnet):
+        self.subscribed.add(subnet)
+        self.events.append(("sub", subnet))
+
+    def unsubscribe(self, subnet):
+        self.subscribed.discard(subnet)
+        self.events.append(("unsub", subnet))
+
+
+def test_attnets_committee_and_random_lifecycle(minimal_preset):
+    rec = _Recorder()
+    md = MetadataController()
+    svc = AttnetsService(
+        subscriber=rec,
+        metadata=md,
+        p=minimal_preset,
+        rand_fn=lambda a, b: a,  # deterministic: shortest random duration
+        shuffle_fn=lambda x: None,  # deterministic: keep order -> subnet 0
+    )
+    svc.on_slot(10)
+    svc.add_committee_subscriptions(
+        [CommitteeSubscription(validator_index=7, subnet=5, slot=12, is_aggregator=True)]
+    )
+    # aggregator committee subnet 5 + random subnet 0 for the validator
+    assert 5 in rec.subscribed and 0 in rec.subscribed
+    assert svc.should_process(5, 12)
+    assert not svc.should_process(5, 14)  # expires after slot+1
+    assert md.attnets[0] and not md.attnets[5]  # only long-lived advertised
+    seq0 = md.seq_number
+
+    # committee subnet expires; random stays
+    svc.on_slot(14)
+    assert 5 not in rec.subscribed and 0 in rec.subscribed
+
+    # random expires after its duration -> renewed while the validator
+    # is still recently seen
+    expiry = 10 + EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION * minimal_preset.SLOTS_PER_EPOCH
+    svc.on_slot(expiry - 1)
+    svc.add_committee_subscriptions(
+        [CommitteeSubscription(validator_index=7, subnet=5, slot=expiry + 2, is_aggregator=False)]
+    )
+    svc.on_slot(expiry + 1)
+    assert len(svc.random_subnets.active(expiry + 1)) == 1
+    assert md.seq_number >= seq0
+
+    # with the validator timed out (150 slots unseen), the lapsed random
+    # subnet is NOT renewed
+    far = expiry + 1 + 3 * EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION * minimal_preset.SLOTS_PER_EPOCH
+    svc.on_slot(far)
+    assert svc.random_subnets.active(far) == []
+
+
+def test_syncnets_and_metadata_seq(minimal_preset):
+    rec = _Recorder()
+    md = MetadataController()
+    svc = SyncnetsService(subscriber=rec, metadata=md, p=minimal_preset)
+    svc.on_slot(1)
+    svc.add_sync_committee_subscriptions(
+        [CommitteeSubscription(validator_index=1, subnet=2, slot=100, is_aggregator=False)]
+    )
+    assert rec.subscribed == {2}
+    assert md.syncnets[2] and md.seq_number == 1
+    svc.on_slot(101)
+    assert rec.subscribed == set()
+    assert md.seq_number == 2  # unsubscription bumped seq again
+
+
+def test_peer_discovery_matches_subnets():
+    records = [
+        EnrRecord(node_id="a", attnets=[i == 3 for i in range(ATTESTATION_SUBNET_COUNT)]),
+        EnrRecord(node_id="b", attnets=[i == 4 for i in range(ATTESTATION_SUBNET_COUNT)]),
+        EnrRecord(node_id="c", attnets=[i in (3, 4) for i in range(ATTESTATION_SUBNET_COUNT)]),
+    ]
+    dialed = []
+    disc = PeerDiscovery(
+        enr_source=lambda: records, dial=lambda r: dialed.append(r.node_id), connected=lambda: {"a"}
+    )
+    n = disc.discover_peers([SubnetRequest("attnet", 3, 1), SubnetRequest("attnet", 4, 1)])
+    # "a" is already connected; "b" serves 4, "c" serves both
+    assert n == len(dialed) and set(dialed) <= {"b", "c"}
+    assert 4 in [s for r in records if r.node_id in dialed for s in (3, 4) if r.serves("attnet", s)]
+    # repeated call doesn't re-dial in-flight peers
+    assert disc.discover_peers([SubnetRequest("attnet", 4, 1)]) == 0 or "b" not in dialed
+
+
+def _bid_response(p, fork="capella"):
+    from lodestar_tpu.ssz.json import to_json
+
+    t = ssz_types(p)
+    bid = getattr(t, fork).SignedBuilderBid.default()
+    bid.message.value = 123
+    bid.message.header.block_hash = b"\x42" * 32
+    return {"data": to_json(getattr(t, fork).SignedBuilderBid, bid)}
+
+
+def test_builder_circuit_breaker_and_flow(minimal_preset):
+    p = minimal_preset
+    calls = []
+
+    def transport(method, path, body=None):
+        calls.append((method, path))
+        if path == "/eth/v1/builder/status":
+            return None
+        if path.startswith("/eth/v1/builder/header/"):
+            return _bid_response(p)
+        if path == "/eth/v1/builder/validators":
+            return None
+        if path == "/eth/v1/builder/blinded_blocks":
+            from lodestar_tpu.ssz.json import to_json
+
+            t = ssz_types(p)
+            payload = t.capella.ExecutionPayload.default()
+            payload.block_hash = b"\x42" * 32
+            return {"data": to_json(t.capella.ExecutionPayload, payload)}
+        raise AssertionError(path)
+
+    b = ExecutionBuilderHttp(transport, p, fault_inspection_window=16, allowed_faults=2)
+    assert b.fault_inspection_window == 16 and b.allowed_faults == 2
+    assert not b.status
+    b.update_status(True)
+    b.check_status()
+    assert b.status  # status probe succeeded
+
+    # circuit breaker: 3 faults in the window > allowed 2
+    for slot in (10, 11, 12):
+        b.register_fault(slot)
+    assert b.is_circuit_broken(13)
+    assert not b.is_circuit_broken(13 + 20)  # window slides past the faults
+
+    # header + blinded submit roundtrip
+    bid = b.get_header(5, b"\x01" * 32, b"\xaa" * 48)
+    assert int(bid.message.value) == 123
+    t = ssz_types(p)
+    blinded = t.capella.SignedBlindedBeaconBlock.default()
+    blinded.message.body.execution_payload_header.block_hash = b"\x42" * 32
+    payload = b.submit_blinded_block(blinded)
+    assert bytes(payload.block_hash) == b"\x42" * 32
+
+    # a builder returning a mismatched payload is rejected
+    blinded2 = t.capella.SignedBlindedBeaconBlock.default()
+    blinded2.message.body.execution_payload_header.block_hash = b"\x43" * 32
+    with pytest.raises(BuilderError):
+        b.submit_blinded_block(blinded2)
+
+    # failing status probe disables
+    def bad_transport(method, path, body=None):
+        raise ConnectionError("down")
+
+    b2 = ExecutionBuilderHttp(bad_transport, p, fault_inspection_window=16)
+    b2.update_status(True)
+    b2.check_status()
+    assert not b2.status
