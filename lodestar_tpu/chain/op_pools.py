@@ -151,34 +151,72 @@ class AggregatedAttestationPool:
             seen[root] = bits if prev is None else (prev | bits)
         return seen
 
-    def get_attestations_for_block(self, state, p, max_attestations: int | None = None) -> list:
+    def get_attestations_for_block(
+        self, state, p, max_attestations: int | None = None, ctx=None
+    ) -> list:
         """Greedy selection of includable aggregates for a block built on
         `state` (already advanced to the block slot), scored by how many
-        NEW attesters each contributes over what the state has on chain
-        (reference `aggregatedAttestationPool.ts:110`)."""
-        from lodestar_tpu.types import ssz_types
-
-        t = ssz_types()
+        NEW attesters each contributes over what the state has on chain.
+        phase0 reads pending attestations; altair+ reads the TIMELY_TARGET
+        participation flags through the committee (reference
+        `aggregatedAttestationPool.ts:110` getNotSeenValidatorsFn)."""
         max_attestations = max_attestations or p.MAX_ATTESTATIONS
-        on_chain = self._on_chain_bits(state)
+        is_phase0 = hasattr(state, "previous_epoch_attestations")
+        on_chain = self._on_chain_bits(state) if is_phase0 else None
+        if not is_phase0 and ctx is None:
+            from lodestar_tpu.state_transition import EpochContext
+
+            ctx = EpochContext(state, p)
         state_slot = state.slot
         scored = []
         for slot in sorted(self._by_slot, reverse=True):
             if not (slot + p.MIN_ATTESTATION_INCLUSION_DELAY <= state_slot <= slot + p.SLOTS_PER_EPOCH):
                 continue
             for root, group in self._by_slot[slot].items():
-                chain_bits = on_chain.get(root)
+                chain_bits = on_chain.get(root) if on_chain is not None else None
                 for entry in group:
                     bits = entry["bits"]
-                    fresh = (
-                        int(bits.sum())
-                        if chain_bits is None or chain_bits.shape != bits.shape
-                        else int((bits & ~chain_bits).sum())
-                    )
+                    if is_phase0:
+                        fresh = (
+                            int(bits.sum())
+                            if chain_bits is None or chain_bits.shape != bits.shape
+                            else int((bits & ~chain_bits).sum())
+                        )
+                    else:
+                        fresh = self._fresh_count_altair(
+                            state, ctx, entry["attestation"], bits, p
+                        )
                     if fresh > 0:
                         scored.append((fresh, slot, entry["attestation"]))
         scored.sort(key=lambda x: (x[0], x[1]), reverse=True)
         return [att for _, _, att in scored[:max_attestations]]
+
+    @staticmethod
+    def _fresh_count_altair(state, ctx, attestation, bits: np.ndarray, p) -> int:
+        """Attesters in `bits` whose TIMELY_TARGET flag is not yet set in
+        the state's participation for the attestation's epoch."""
+        data = attestation.data
+        cur_epoch = state.slot // p.SLOTS_PER_EPOCH
+        if data.target.epoch == cur_epoch:
+            flags = state.current_epoch_participation
+        elif data.target.epoch == cur_epoch - 1:
+            flags = state.previous_epoch_participation
+        else:
+            return 0
+        try:
+            committee = ctx.get_beacon_committee(data.slot, data.index)
+        except ValueError:
+            return 0
+        if len(committee) != bits.shape[0]:
+            return 0
+        from lodestar_tpu.params import TIMELY_TARGET_FLAG_INDEX
+
+        timely_target = 1 << TIMELY_TARGET_FLAG_INDEX
+        return sum(
+            1
+            for i, b in enumerate(bits)
+            if b and not (int(flags[int(committee[i])]) & timely_target)
+        )
 
     def prune(self, clock_slot: int) -> None:
         self._lowest_permissible_slot = max(0, clock_slot - SLOTS_RETAINED)

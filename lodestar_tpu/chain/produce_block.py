@@ -99,7 +99,9 @@ def produce_block(
     body.proposer_slashings = prop_slashings
     body.attester_slashings = att_slashings
     body.voluntary_exits = exits
-    body.attestations = chain.aggregated_attestation_pool.get_attestations_for_block(work, p)
+    body.attestations = chain.aggregated_attestation_pool.get_attestations_for_block(
+        work, p, ctx=ctx
+    )
 
     block.state_root = compute_new_state_root(chain, work, block, ctx)
     return block

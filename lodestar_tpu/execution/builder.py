@@ -125,6 +125,8 @@ class ExecutionBuilderHttp:
         res = self.transport("GET", path)
         if res is None:
             return None
+        if "data" not in res:
+            raise BuilderError(f"builder header response missing data: {res!r}")
         t = ssz_types(self.p)
         bid_type = getattr(t, fork).SignedBuilderBid
         return from_json(bid_type, res["data"])

@@ -72,6 +72,19 @@ class _SubnetMap:
         return self._expiry.get(subnet, -1) >= slot
 
 
+def _reconcile_subscriptions(want: set, subscribed: set, subscriber) -> set:
+    """Diff the wanted subnet set against the currently-subscribed one,
+    issuing subscribe/unsubscribe calls; returns the new subscribed set
+    (shared by attnets and syncnets)."""
+    for subnet in sorted(want - subscribed):
+        if subscriber is not None:
+            subscriber.subscribe(subnet)
+    for subnet in sorted(subscribed - want):
+        if subscriber is not None:
+            subscriber.unsubscribe(subnet)
+    return want
+
+
 class MetadataController:
     """The node's gossip metadata record (reference
     network/metadata.ts): seq_number increments whenever the advertised
@@ -188,14 +201,9 @@ class AttnetsService:
         )
 
     def _reconcile(self) -> None:
-        want = set(self.active_subnets())
-        for subnet in sorted(want - self._gossip_subscribed):
-            if self.subscriber is not None:
-                self.subscriber.subscribe(subnet)
-        for subnet in sorted(self._gossip_subscribed - want):
-            if self.subscriber is not None:
-                self.subscriber.unsubscribe(subnet)
-        self._gossip_subscribed = want
+        self._gossip_subscribed = _reconcile_subscriptions(
+            set(self.active_subnets()), self._gossip_subscribed, self.subscriber
+        )
         # only long-lived subnets are advertised in the ENR/metadata
         # (reference updateMetadata uses random subnets)
         self.metadata.update_attnets(self.random_subnets.active(self._current_slot))
@@ -234,11 +242,7 @@ class SyncnetsService:
 
     def _reconcile(self) -> None:
         want = set(self.subnets.active(self._current_slot))
-        for subnet in sorted(want - self._gossip_subscribed):
-            if self.subscriber is not None:
-                self.subscriber.subscribe(subnet)
-        for subnet in sorted(self._gossip_subscribed - want):
-            if self.subscriber is not None:
-                self.subscriber.unsubscribe(subnet)
-        self._gossip_subscribed = want
+        self._gossip_subscribed = _reconcile_subscriptions(
+            want, self._gossip_subscribed, self.subscriber
+        )
         self.metadata.update_syncnets(sorted(want))

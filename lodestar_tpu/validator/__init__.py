@@ -81,7 +81,66 @@ class Validator:
 
         # -- aggregation round (services/attestation.ts second phase) --
         out["aggregates"] = self._run_aggregation(slot, work, ctx, t)
+
+        # -- sync committee duties (services/syncCommittee.ts) --
+        from lodestar_tpu.state_transition.block import fork_of
+
+        if fork_of(work) != "phase0":
+            out["sync_messages"], out["sync_contributions"] = self._run_sync_duties(
+                slot, work, t, ctx
+            )
         return out
+
+    def _run_sync_duties(self, slot: int, work, t, ctx) -> tuple[list, list]:
+        """Sign SyncCommitteeMessages for every managed member of the
+        current sync committee, then run the contribution-aggregator
+        phase over the message pool (reference
+        services/syncCommittee.ts + syncCommitteeDuties.ts)."""
+        from lodestar_tpu.chain.validation import is_sync_committee_aggregator
+        from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_COUNT
+
+        p = self.p
+        head_root = self.chain.head_root
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        committee_pks = [bytes(pk) for pk in work.current_sync_committee.pubkeys]
+
+        messages = []
+        vi_by_pk = ctx.pubkey_to_index(work)  # cached on the context
+        for pos, pk in enumerate(committee_pks):
+            if not self.store.has_pubkey(pk):
+                continue
+            subnet = pos // sub_size
+            msg = t.SyncCommitteeMessage.default()
+            msg.slot = slot
+            msg.beacon_block_root = head_root
+            msg.validator_index = vi_by_pk.get(pk, 0)
+            msg.signature = self.store.sign_sync_committee_message(pk, slot, head_root)
+            self.chain.sync_committee_message_pool.add(subnet, msg, pos % sub_size)
+            messages.append(msg)
+
+        contributions = []
+        for subnet in range(SYNC_COMMITTEE_SUBNET_COUNT):
+            window = committee_pks[subnet * sub_size : (subnet + 1) * sub_size]
+            for pk in window:
+                if not self.store.has_pubkey(pk):
+                    continue
+                proof = self.store.sign_sync_selection_proof(pk, slot, subnet)
+                if not is_sync_committee_aggregator(proof, p):
+                    continue
+                contribution = self.chain.sync_committee_message_pool.get_contribution(
+                    subnet, slot, head_root
+                )
+                if contribution is None:
+                    continue
+                cp = t.ContributionAndProof.default()
+                cp.aggregator_index = vi_by_pk.get(pk, 0)
+                cp.contribution = contribution
+                cp.selection_proof = proof
+                signed = self.store.sign_contribution_and_proof(pk, cp)
+                self.chain.sync_contribution_pool.add(cp)
+                contributions.append(signed)
+                break  # one aggregator per subnet suffices locally
+        return messages, contributions
 
     def _run_aggregation(self, slot: int, work, ctx, t) -> list:
         """Selected aggregators publish SignedAggregateAndProof into the

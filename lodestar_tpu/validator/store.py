@@ -66,13 +66,17 @@ class ValidatorStore:
 
     def sign_block(self, pubkey: bytes, block) -> bytes:
         """Signed block — the slashing DB records the signing root BEFORE
-        the signature leaves this process."""
+        the signature leaves this process. Fork-aware: the block's own
+        container type names the fork namespace."""
+        from lodestar_tpu.state_transition.block import fork_of
+
         t = ssz_types(self.p)
+        ns = getattr(t, fork_of(block))  # fork_of reads any container's type name
         epoch = compute_epoch_at_slot(block.slot, self.p)
         domain = self.config.get_domain(DOMAIN_BEACON_PROPOSER, epoch)
-        root = _signing_root(t.phase0.BeaconBlock, block, domain)
+        root = _signing_root(ns.BeaconBlock, block, domain)
         self.slashing.check_and_insert_block_proposal(pubkey, block.slot, root)
-        signed = t.phase0.SignedBeaconBlock.default()
+        signed = ns.SignedBeaconBlock.default()
         signed.message = block
         signed.signature = sign(self._sk(pubkey), root)
         return signed
@@ -102,6 +106,45 @@ class ValidatorStore:
         root = _signing_root(t.AggregateAndProof, agg_and_proof, domain)
         signed = t.SignedAggregateAndProof.default()
         signed.message = agg_and_proof
+        signed.signature = sign(self._sk(pubkey), root)
+        return signed
+
+    def sign_sync_committee_message(self, pubkey: bytes, slot: int, block_root: bytes) -> bytes:
+        """SyncCommitteeMessage signature over the head block root
+        (reference signSyncCommitteeSignature). SigningData of a raw
+        Root is sha256(root || domain)."""
+        import hashlib
+
+        from lodestar_tpu.params import DOMAIN_SYNC_COMMITTEE
+
+        epoch = compute_epoch_at_slot(slot, self.p)
+        domain = self.config.get_domain(DOMAIN_SYNC_COMMITTEE, epoch)
+        return sign(self._sk(pubkey), hashlib.sha256(bytes(block_root) + domain).digest())
+
+    def sign_sync_selection_proof(self, pubkey: bytes, slot: int, subcommittee_index: int) -> bytes:
+        """SyncAggregatorSelectionData proof (reference
+        signSyncCommitteeSelectionProof)."""
+        from lodestar_tpu.params import DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+
+        t = ssz_types(self.p)
+        data = t.SyncAggregatorSelectionData.default()
+        data.slot = slot
+        data.subcommittee_index = subcommittee_index
+        epoch = compute_epoch_at_slot(slot, self.p)
+        domain = self.config.get_domain(DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch)
+        return sign(self._sk(pubkey), _signing_root(t.SyncAggregatorSelectionData, data, domain))
+
+    def sign_contribution_and_proof(self, pubkey: bytes, contribution_and_proof):
+        """SignedContributionAndProof envelope (reference
+        signContributionAndProof)."""
+        from lodestar_tpu.params import DOMAIN_CONTRIBUTION_AND_PROOF
+
+        t = ssz_types(self.p)
+        epoch = compute_epoch_at_slot(contribution_and_proof.contribution.slot, self.p)
+        domain = self.config.get_domain(DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+        root = _signing_root(t.ContributionAndProof, contribution_and_proof, domain)
+        signed = t.SignedContributionAndProof.default()
+        signed.message = contribution_and_proof
         signed.signature = sign(self._sk(pubkey), root)
         return signed
 
