@@ -114,9 +114,13 @@ class _Router:
         return self.api.get_spec()
 
 
-class BeaconRestApiServer:
-    def __init__(self, api: BeaconApiImpl, *, host: str = "127.0.0.1", port: int = 9596):
-        self.router = _Router(api)
+class RestServer:
+    """Threaded stdlib HTTP server over any router exposing
+    `dispatch(method, path, query, body)` (the Beacon API and the
+    validator keymanager API share this host)."""
+
+    def __init__(self, router, *, host: str = "127.0.0.1", port: int = 9596):
+        self.router = router
         self.host = host
         self.port = port
         self._httpd = None
@@ -134,7 +138,7 @@ class BeaconRestApiServer:
                 query = dict(parse_qsl(parts.query))
                 try:
                     body = None
-                    if method == "POST":
+                    if method in ("POST", "DELETE"):
                         length = int(self.headers.get("Content-Length") or 0)
                         raw = self.rfile.read(length) if length else b""
                         try:
@@ -170,6 +174,9 @@ class BeaconRestApiServer:
             def do_POST(self):  # noqa: N802
                 self._run("POST")
 
+            def do_DELETE(self):  # noqa: N802
+                self._run("DELETE")
+
             def log_message(self, *a):
                 pass
 
@@ -183,3 +190,8 @@ class BeaconRestApiServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+class BeaconRestApiServer(RestServer):
+    def __init__(self, api: BeaconApiImpl, *, host: str = "127.0.0.1", port: int = 9596):
+        super().__init__(_Router(api), host=host, port=port)

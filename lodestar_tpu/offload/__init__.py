@@ -1,0 +1,81 @@
+"""Offload channel: gRPC service between the beacon node and the
+device host (SURVEY §2d — "gRPC over DCN for job submission: BlsWorkReq
+batches, hash batches").
+
+The reference runs BLS verification in worker threads over a typed
+MessagePort RPC (`@chainsafe/threads`, `multithread/index.ts`); in the
+TPU architecture the verifier may live in a DIFFERENT PROCESS/HOST that
+owns the accelerator. This package is that boundary:
+
+* `server.BlsOffloadServer` — hosts a verify backend (the device batch
+  verifier or the CPU oracle) behind two RPCs
+* `client.BlsOffloadClient` — an `IBlsVerifier` implementation that
+  ships signature-set frames over the channel; transport errors FAIL
+  CLOSED (the job rejects, never resolves valid — the
+  `multithread/index.ts:386-393` semantics)
+
+Wire format (framed, no codegen needed — grpc carries opaque bytes):
+  request:  u32le count || count * (pubkey48 || message32 || signature96)
+  response: u8 ok(1)/invalid(0)/error(2) || error utf-8
+"""
+
+from __future__ import annotations
+
+from lodestar_tpu.crypto.bls.api import SignatureSet
+
+__all__ = [
+    "encode_sets",
+    "decode_sets",
+    "encode_verdict",
+    "decode_verdict",
+    "OffloadError",
+    "SET_BYTES",
+]
+
+SET_BYTES = 48 + 32 + 96
+
+
+class OffloadError(Exception):
+    pass
+
+
+def encode_sets(sets: list[SignatureSet]) -> bytes:
+    out = bytearray(len(sets).to_bytes(4, "little"))
+    for s in sets:
+        pk, msg, sig = bytes(s.pubkey), bytes(s.message), bytes(s.signature)
+        if len(pk) != 48 or len(msg) != 32 or len(sig) != 96:
+            raise OffloadError("malformed signature set")
+        out += pk + msg + sig
+    return bytes(out)
+
+
+def decode_sets(data: bytes) -> list[SignatureSet]:
+    if len(data) < 4:
+        raise OffloadError("short frame")
+    count = int.from_bytes(data[:4], "little")
+    if len(data) != 4 + count * SET_BYTES:
+        raise OffloadError(f"frame length mismatch for {count} sets")
+    sets = []
+    off = 4
+    for _ in range(count):
+        pk = data[off : off + 48]
+        msg = data[off + 48 : off + 80]
+        sig = data[off + 80 : off + 176]
+        sets.append(SignatureSet(pubkey=pk, message=msg, signature=sig))
+        off += SET_BYTES
+    return sets
+
+
+def encode_verdict(ok: bool | None, error: str = "") -> bytes:
+    if error:
+        return b"\x02" + error.encode()
+    return b"\x01" if ok else b"\x00"
+
+
+def decode_verdict(data: bytes) -> bool:
+    """True/False, or raises OffloadError for a server-side error."""
+    if not data:
+        raise OffloadError("empty verdict frame")
+    if data[0] == 2:
+        raise OffloadError(data[1:].decode(errors="replace") or "server error")
+    return data[0] == 1

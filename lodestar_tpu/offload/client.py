@@ -1,0 +1,70 @@
+"""Offload client: IBlsVerifier over the gRPC channel.
+
+Drop-in replacement for the in-process pools — a BeaconChain configured
+with this verifier ships its signature batches to the accelerator host.
+Transport failures fail CLOSED: verify_signature_sets raises, the block
+import rejects, nothing ever resolves valid on error (reference
+`multithread/index.ts:386-393`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+
+from lodestar_tpu.chain.bls.interface import IBlsVerifier, VerifySignatureOpts
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.logger import get_logger
+
+from . import OffloadError, decode_verdict, encode_sets
+from .server import STATUS_METHOD, VERIFY_METHOD
+
+__all__ = ["BlsOffloadClient"]
+
+DEFAULT_TIMEOUT_S = 30.0
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class BlsOffloadClient(IBlsVerifier):
+    def __init__(self, target: str, *, timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+        self.target = target
+        self.timeout_s = timeout_s
+        self.log = get_logger(name="lodestar.offload.client")
+        self._channel = grpc.insecure_channel(target)
+        self._verify = self._channel.unary_unary(
+            VERIFY_METHOD, request_serializer=_identity, response_deserializer=_identity
+        )
+        self._status = self._channel.unary_unary(
+            STATUS_METHOD, request_serializer=_identity, response_deserializer=_identity
+        )
+
+    async def verify_signature_sets(
+        self, sets: list[SignatureSet], opts: VerifySignatureOpts | None = None
+    ) -> bool:
+        """One RPC per job; blocking stub call moved off the event loop.
+        Raises OffloadError on transport/server error (fail closed)."""
+        frame = encode_sets(list(sets))
+
+        def call() -> bool:
+            try:
+                return decode_verdict(self._verify(frame, timeout=self.timeout_s))
+            except grpc.RpcError as e:
+                raise OffloadError(f"offload transport: {e.code()}") from e
+
+        return await asyncio.get_event_loop().run_in_executor(None, call)
+
+    def can_accept_work(self) -> bool:
+        """False on any transport trouble — shed load rather than queue
+        against a dead service."""
+        try:
+            out = self._status(b"", timeout=2.0)
+            return bool(out and out[0] == 1)
+        except grpc.RpcError:
+            return False
+
+    async def close(self) -> None:
+        self._channel.close()

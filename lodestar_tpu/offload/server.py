@@ -1,0 +1,116 @@
+"""Offload service host: the process that owns the accelerator.
+
+Exposes the verify backend over gRPC generic handlers (opaque-bytes
+methods — no proto codegen needed in this environment):
+
+  /lodestar.BlsOffload/VerifySignatureSets   sets frame -> verdict frame
+  /lodestar.BlsOffload/Status                b"" -> u8 can_accept_work
+
+Run standalone (`python -m lodestar_tpu.offload.server`) next to the
+TPU, with beacon nodes connecting via `client.BlsOffloadClient` over
+DCN (SURVEY §2d).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from lodestar_tpu.logger import get_logger
+
+from . import decode_sets, encode_verdict
+
+__all__ = ["BlsOffloadServer", "SERVICE_NAME", "VERIFY_METHOD", "STATUS_METHOD"]
+
+SERVICE_NAME = "lodestar.BlsOffload"
+VERIFY_METHOD = f"/{SERVICE_NAME}/VerifySignatureSets"
+STATUS_METHOD = f"/{SERVICE_NAME}/Status"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class BlsOffloadServer:
+    """gRPC host around a verify backend.
+
+    backend(sets) -> bool may be sync or return an awaitable-free bool;
+    can_accept_work() -> bool gates admission (mirrors the pool's
+    MAX_JOBS semantics when the backend is a BlsDeviceVerifierPool)."""
+
+    def __init__(
+        self,
+        backend,
+        *,
+        can_accept_work=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_workers: int = 4,
+    ) -> None:
+        self.backend = backend
+        self._can_accept_work = can_accept_work or (lambda: True)
+        self.log = get_logger(name="lodestar.offload")
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "VerifySignatureSets": grpc.unary_unary_rpc_method_handler(
+                self._verify, request_deserializer=_identity, response_serializer=_identity
+            ),
+            "Status": grpc.unary_unary_rpc_method_handler(
+                self._status, request_deserializer=_identity, response_serializer=_identity
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    # -- handlers --------------------------------------------------------------
+
+    def _verify(self, request: bytes, context) -> bytes:
+        try:
+            sets = decode_sets(request)
+            ok = bool(self.backend(sets))
+            return encode_verdict(ok)
+        except Exception as e:  # error frame, not a transport abort
+            self.log.warn("verify job failed", {"error": str(e)})
+            return encode_verdict(None, error=f"{type(e).__name__}: {e}")
+
+    def _status(self, request: bytes, context) -> bytes:
+        return b"\x01" if self._can_accept_work() else b"\x00"
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._server.start()
+        self.log.info("offload service up", {"port": self.port})
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+def main() -> int:
+    """Standalone entry: host the repo's own verifier."""
+    import argparse
+
+    from lodestar_tpu.crypto.bls.api import verify_signature_sets
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=50051)
+    args = ap.parse_args()
+    server = BlsOffloadServer(verify_signature_sets, port=args.port)
+    server.start()
+    import signal
+    import threading
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

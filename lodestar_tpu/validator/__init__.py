@@ -44,13 +44,19 @@ class Validator:
             from lodestar_tpu.chain.produce_block import produce_block
 
             epoch = slot // self.p.SLOTS_PER_EPOCH
-            reveal = self.store.sign_randao(proposer_pk, epoch)
-            block = produce_block(self.chain, slot=slot, randao_reveal=reveal)
-            signed = self.store.sign_block(proposer_pk, block)
-            await self.chain.process_block(signed, is_timely=True)
-            out["proposed"] = signed
-            # duties for the rest of the slot run on the new head
-            work, ctx = dial_to_slot(self.chain.get_head_state(), slot, self.p, self.chain.cfg)
+            try:
+                reveal = self.store.sign_randao(proposer_pk, epoch)
+                block = produce_block(self.chain, slot=slot, randao_reveal=reveal)
+                signed = self.store.sign_block(proposer_pk, block)
+            except ValueError:
+                signed = None  # key removed concurrently by the keymanager
+            if signed is not None:
+                await self.chain.process_block(signed, is_timely=True)
+                out["proposed"] = signed
+                # duties for the rest of the slot run on the new head
+                work, ctx = dial_to_slot(
+                    self.chain.get_head_state(), slot, self.p, self.chain.cfg
+                )
 
         # -- attestations (services/attestation.ts) --
         from lodestar_tpu.chain.produce_block import make_attestation_data
@@ -66,7 +72,10 @@ class Validator:
                 pk = bytes(work.validators[int(vi)].pubkey)
                 if not self.store.has_pubkey(pk):
                     continue
-                sig = self.store.sign_attestation(pk, data)
+                try:
+                    sig = self.store.sign_attestation(pk, data)
+                except ValueError:
+                    continue  # key removed concurrently by the keymanager
                 att = t.Attestation.default()
                 bits = [False] * len(committee)
                 bits[pos] = True
@@ -102,7 +111,17 @@ class Validator:
         p = self.p
         head_root = self.chain.head_root
         sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
-        committee_pks = [bytes(pk) for pk in work.current_sync_committee.pubkeys]
+        # the aggregate lands in the block at slot+1 and is verified
+        # against THAT state's current committee — at the last slot of a
+        # period the rotated (next_) committee must sign (the gossip
+        # validator's _committee_for_slot handles the same boundary)
+        period_len = p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * p.SLOTS_PER_EPOCH
+        committee = (
+            work.next_sync_committee
+            if (slot + 1) // period_len > int(work.slot) // period_len
+            else work.current_sync_committee
+        )
+        committee_pks = [bytes(pk) for pk in committee.pubkeys]
 
         messages = []
         vi_by_pk = ctx.pubkey_to_index(work)  # cached on the context
@@ -114,7 +133,10 @@ class Validator:
             msg.slot = slot
             msg.beacon_block_root = head_root
             msg.validator_index = vi_by_pk.get(pk, 0)
-            msg.signature = self.store.sign_sync_committee_message(pk, slot, head_root)
+            try:
+                msg.signature = self.store.sign_sync_committee_message(pk, slot, head_root)
+            except ValueError:
+                continue  # key removed concurrently
             self.chain.sync_committee_message_pool.add(subnet, msg, pos % sub_size)
             messages.append(msg)
 
@@ -124,7 +146,10 @@ class Validator:
             for pk in window:
                 if not self.store.has_pubkey(pk):
                     continue
-                proof = self.store.sign_sync_selection_proof(pk, slot, subnet)
+                try:
+                    proof = self.store.sign_sync_selection_proof(pk, slot, subnet)
+                except ValueError:
+                    continue  # key removed concurrently
                 if not is_sync_committee_aggregator(proof, p):
                     continue
                 contribution = self.chain.sync_committee_message_pool.get_contribution(
@@ -155,7 +180,10 @@ class Validator:
                 pk = bytes(work.validators[int(vi)].pubkey)
                 if not self.store.has_pubkey(pk):
                     continue
-                proof = self.store.sign_selection_proof(pk, slot)
+                try:
+                    proof = self.store.sign_selection_proof(pk, slot)
+                except ValueError:
+                    continue  # key removed concurrently
                 if not is_aggregator(len(committee), proof):
                     continue
                 # aggregate what the naive pool collected for this data

@@ -56,6 +56,14 @@ class ValidatorStore:
     def has_pubkey(self, pubkey: bytes) -> bool:
         return pubkey in self._by_pubkey
 
+    def add_secret_key(self, sk: SecretKey) -> None:
+        """Runtime key import (keymanager API)."""
+        self._by_pubkey[sk.to_pubkey()] = sk
+
+    def remove_pubkey(self, pubkey: bytes) -> bool:
+        """Runtime key removal (keymanager API); slashing history stays."""
+        return self._by_pubkey.pop(pubkey, None) is not None
+
     def _sk(self, pubkey: bytes) -> SecretKey:
         sk = self._by_pubkey.get(pubkey)
         if sk is None:

@@ -1,0 +1,157 @@
+"""Offload channel: wire format, gRPC roundtrip with real BLS sets,
+fail-closed transport semantics, and chain integration (a BeaconChain
+importing a block through the offload verifier)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_sets
+from lodestar_tpu.offload import (
+    OffloadError,
+    decode_sets,
+    decode_verdict,
+    encode_sets,
+    encode_verdict,
+)
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.state_transition.genesis import interop_secret_keys
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def _sets(n: int, tamper: int | None = None) -> list[SignatureSet]:
+    sks = interop_secret_keys(n)
+    out = []
+    for i, sk in enumerate(sks):
+        msg = bytes([i]) * 32
+        sig = bls.sign(sk, msg)
+        if i == tamper:
+            sig = bls.sign(sk, b"\xff" * 32)  # valid sig, wrong message
+        out.append(SignatureSet(pubkey=sk.to_pubkey(), message=msg, signature=sig))
+    return out
+
+
+def test_frame_roundtrip_and_malformed():
+    sets = _sets(3)
+    frame = encode_sets(sets)
+    back = decode_sets(frame)
+    assert [(s.pubkey, s.message, s.signature) for s in back] == [
+        (bytes(s.pubkey), bytes(s.message), bytes(s.signature)) for s in sets
+    ]
+    with pytest.raises(OffloadError):
+        decode_sets(frame[:-1])  # truncated
+    with pytest.raises(OffloadError):
+        decode_sets(b"\xff\xff\xff\xff" + b"\x00" * 10)  # count lies
+    assert decode_verdict(encode_verdict(True)) is True
+    assert decode_verdict(encode_verdict(False)) is False
+    with pytest.raises(OffloadError, match="boom"):
+        decode_verdict(encode_verdict(None, error="boom"))
+
+
+def test_grpc_roundtrip_real_bls():
+    server = BlsOffloadServer(verify_signature_sets, port=0)
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}")
+    try:
+
+        async def go():
+            assert await client.verify_signature_sets(_sets(3)) is True
+            assert await client.verify_signature_sets(_sets(3, tamper=1)) is False
+            assert client.can_accept_work()
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+
+def test_server_error_and_dead_transport_fail_closed():
+    def exploding_backend(sets):
+        raise RuntimeError("device on fire")
+
+    server = BlsOffloadServer(exploding_backend, can_accept_work=lambda: False, port=0)
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}")
+    try:
+
+        async def go():
+            with pytest.raises(OffloadError, match="device on fire"):
+                await client.verify_signature_sets(_sets(1))
+            assert not client.can_accept_work()  # admission says no
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+    # nothing listening: errors, never resolves valid
+    dead = BlsOffloadClient("127.0.0.1:1", timeout_s=1.0)
+    try:
+
+        async def go_dead():
+            with pytest.raises(OffloadError):
+                await dead.verify_signature_sets(_sets(1))
+            assert not dead.can_accept_work()
+
+        asyncio.run(go_dead())
+    finally:
+        asyncio.run(dead.close())
+
+
+def test_chain_imports_block_through_offload_verifier(minimal_preset):
+    """Full integration: BeaconChain whose bls verifier is the gRPC
+    client; a signed block with real signatures imports end-to-end."""
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
+
+    from ..state_transition.test_state_transition import _empty_block_at
+
+    p = minimal_preset
+    N = 16
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    server = BlsOffloadServer(verify_signature_sets, port=0)
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}")
+    try:
+        chain = BeaconChain(
+            anchor_state=genesis, bls_verifier=client, db=MemoryDbController(), current_slot=1
+        )
+        signed = _empty_block_at(genesis, 1, sks, p)
+
+        async def go():
+            await chain.process_block(signed)
+
+        asyncio.run(go())
+        assert chain.get_head_state().slot == 1
+
+        # a tampered proposer signature must reject through the channel
+        bad = signed.copy()
+        bad.signature = b"\xc0" + bytes(95)
+
+        async def go_bad():
+            from lodestar_tpu.chain.chain import BlockError
+
+            chain2 = BeaconChain(
+                anchor_state=genesis, bls_verifier=client, db=MemoryDbController(), current_slot=1
+            )
+            with pytest.raises(BlockError):
+                await chain2.process_block(bad)
+
+        asyncio.run(go_bad())
+    finally:
+        asyncio.run(client.close())
+        server.stop()

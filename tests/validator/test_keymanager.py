@@ -1,0 +1,134 @@
+"""Keymanager API: local keystore lifecycle (list/import/delete with
+EIP-3076 interchange), remote keys, fee recipient / gas limit, and the
+REST surface end-to-end over HTTP."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.config import create_beacon_config, minimal_chain_config
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.state_transition.genesis import interop_secret_keys
+from lodestar_tpu.validator import SlashingProtection, ValidatorStore
+from lodestar_tpu.validator.keymanager import (
+    KeymanagerApi,
+    create_keymanager_server,
+)
+from lodestar_tpu.validator.keystore import encrypt_keystore
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def _store(sks, p):
+    cfg = create_beacon_config(minimal_chain_config(), b"\x00" * 32)
+    return ValidatorStore(cfg, SlashingProtection(MemoryDbController()), sks, p)
+
+
+def test_keystore_lifecycle(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(4)
+    store = _store(sks[:2], p)
+    km = KeymanagerApi(store)
+
+    keys = km.list_keys()
+    assert len(keys) == 2 and all(not k["readonly"] for k in keys)
+
+    # import: one new, one duplicate, one garbage
+    new_sk = sks[2]
+    ks_json = encrypt_keystore(new_sk.scalar.to_bytes(32, 'big'), "hunter2", pubkey=new_sk.to_pubkey())
+    dup_json = encrypt_keystore(sks[0].scalar.to_bytes(32, 'big'), "pw", pubkey=sks[0].to_pubkey())
+    statuses = km.import_keystores(
+        [json.dumps(ks_json), json.dumps(dup_json), "{}"], ["hunter2", "pw", "x"]
+    )
+    assert [s["status"] for s in statuses] == ["imported", "duplicate", "error"]
+    assert store.has_pubkey(new_sk.to_pubkey())
+
+    # delete: removes the key and returns the interchange
+    out = km.delete_keys(["0x" + new_sk.to_pubkey().hex(), "0x" + "ee" * 48])
+    assert [s["status"] for s in out["statuses"]] == ["deleted", "not_found"]
+    assert not store.has_pubkey(new_sk.to_pubkey())
+    interchange = json.loads(out["slashing_protection"])
+    assert "metadata" in interchange
+
+
+def test_remote_keys_and_proposer_config(minimal_preset):
+    p = minimal_preset
+    store = _store(interop_secret_keys(1), p)
+    km = KeymanagerApi(store)
+    pk_hex = "0x" + ("ab" * 48)
+    assert km.import_remote_keys([{"pubkey": pk_hex, "url": "https://signer"}]) == [
+        {"status": "imported", "message": ""}
+    ]
+    assert km.list_remote_keys()[0]["url"] == "https://signer"
+    assert km.delete_remote_keys([pk_hex]) == [{"status": "deleted", "message": ""}]
+
+    km.set_fee_recipient(pk_hex, "0x" + "AA" * 20)
+    assert km.get_fee_recipient(pk_hex)["ethaddress"] == "0x" + "aa" * 20
+    with pytest.raises(ValueError):
+        km.set_fee_recipient(pk_hex, "nonsense")
+    km.delete_fee_recipient(pk_hex)
+    assert km.get_fee_recipient(pk_hex)["ethaddress"] == km.default_fee_recipient
+    km.set_gas_limit(pk_hex, 12345)
+    assert km.get_gas_limit(pk_hex)["gas_limit"] == "12345"
+
+
+def test_keymanager_rest_server(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(2)
+    store = _store(sks, p)
+    km = KeymanagerApi(store)
+    server = create_keymanager_server(km, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(base + "/eth/v1/keystores") as r:
+            data = json.loads(r.read())["data"]
+        assert len(data) == 2
+
+        # DELETE with body
+        req = urllib.request.Request(
+            base + "/eth/v1/keystores",
+            method="DELETE",
+            data=json.dumps({"pubkeys": ["0x" + sks[0].to_pubkey().hex()]}).encode(),
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["data"][0]["status"] == "deleted"
+        assert "slashing_protection" in out
+
+        # fee recipient roundtrip over HTTP
+        pk_hex = "0x" + sks[1].to_pubkey().hex()
+        req = urllib.request.Request(
+            base + f"/eth/v1/validator/{pk_hex}/feerecipient",
+            method="POST",
+            data=json.dumps({"ethaddress": "0x" + "cc" * 20}).encode(),
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 202
+        with urllib.request.urlopen(base + f"/eth/v1/validator/{pk_hex}/feerecipient") as r:
+            assert json.loads(r.read())["data"]["ethaddress"] == "0x" + "cc" * 20
+
+        # bad input -> 400, unknown route -> 404
+        req = urllib.request.Request(
+            base + f"/eth/v1/validator/{pk_hex}/gas_limit",
+            method="POST",
+            data=json.dumps({"gas_limit": -5}).encode(),
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/eth/v1/nonsense")
+        assert exc.value.code == 404
+    finally:
+        server.stop()
