@@ -23,13 +23,18 @@ reads only the first printed line.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
 REFERENCE_SIGS_PER_SEC_PER_CORE = 2200.0  # blst envelope, see module docstring
 BATCH = 128  # sets per gossip job (the north-star workload unit)
-MERGE_JOBS = 8  # buffered jobs merged into one RLC device batch
+# buffered jobs merged into one RLC device batch. Swept on the real v5e-1:
+# 8 jobs (1024 sets) -> 786 sigs/s, 32 -> 1250, 128 -> 1153; the knee is
+# ~32 jobs where the program stops being latency-bound. Overridable for
+# batch-width sweeps.
+MERGE_JOBS = int(os.environ.get("LODESTAR_BENCH_MERGE_JOBS", "32"))
 ITERS = 3
 
 

@@ -113,18 +113,32 @@ class BeaconApiImpl:
         return bytes.fromhex(node.block_root[2:])
 
     def get_block_v2(self, block_id: str) -> dict:
+        from lodestar_tpu.state_transition.block import fork_of
+
         root = self._block_root(block_id)
         signed = self.chain.get_block_by_root(root)
         if signed is None:
             raise ApiError(404, f"block {block_id} not found")
+        fork = fork_of(signed.message)
         return {
-            "version": "phase0",
+            "version": fork,
             "execution_optimistic": False,
-            "data": to_json(self.t.phase0.SignedBeaconBlock, signed),
+            "data": to_json(getattr(self.t, fork).SignedBeaconBlock, signed),
         }
 
     def publish_block(self, body: dict) -> dict:
-        signed = from_json(self.t.phase0.SignedBeaconBlock, body)
+        # decode with the fork active at the block's slot (the standard
+        # API sends the version in a header the stdlib router doesn't
+        # surface; the slot determines it just as well)
+        try:
+            slot = int(body["message"]["slot"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ApiError(400, f"malformed block body: {e}") from e
+        fork = self.chain.fork_name_at_slot(slot)
+        try:
+            signed = from_json(getattr(self.t, fork).SignedBeaconBlock, body)
+        except (KeyError, TypeError, ValueError) as e:
+            raise ApiError(400, f"cannot decode {fork} block: {e}") from e
         from lodestar_tpu.chain.chain import BlockError
 
         try:
@@ -243,7 +257,10 @@ class BeaconApiImpl:
             randao_reveal=bytes.fromhex(randao_reveal[2:]),
             graffiti=bytes.fromhex(graffiti[2:]) if graffiti.startswith("0x") else graffiti.encode(),
         )
-        return {"version": "phase0", "data": to_json(self.t.phase0.BeaconBlock, block)}
+        from lodestar_tpu.state_transition.block import fork_of
+
+        fork = fork_of(block)
+        return {"version": fork, "data": to_json(getattr(self.t, fork).BeaconBlock, block)}
 
     def produce_attestation_data(self, slot: int, committee_index: int) -> dict:
         from lodestar_tpu.chain.produce_block import make_attestation_data
@@ -275,8 +292,10 @@ class BeaconApiImpl:
     # -- debug / config -------------------------------------------------------
 
     def get_debug_state_v2(self, state_id: str) -> dict:
+        from lodestar_tpu.state_transition.block import fork_of
+
         st = self._state_at(state_id)
-        return {"version": "phase0", "data": to_json(st.type, st)}
+        return {"version": fork_of(st), "data": to_json(st.type, st)}
 
     def get_spec(self) -> dict:
         p = self.p

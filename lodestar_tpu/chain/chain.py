@@ -149,20 +149,29 @@ class BeaconChain:
         anchor_root = genesis_block_root or t.BeaconBlockHeader.hash_tree_root(header)
         self.state_cache.add(anchor_root, anchor_state)
 
+        # anchor checkpoint = (epoch of the anchor slot, anchor block
+        # root) for BOTH store checkpoints; for a non-genesis anchor the
+        # justified epoch is bumped +1 so the chain cannot justify with
+        # a block that doesn't also finalize the anchor — head stays at
+        # the anchor until a real justification lands (reference
+        # `chain/forkChoice/index.ts initializeForkChoice`)
         anchor_epoch = compute_epoch_at_slot(anchor_state.slot, p)
-        anchor_cp = Checkpoint(anchor_epoch, _hex(anchor_root))
+        finalized_cp = Checkpoint(anchor_epoch, _hex(anchor_root))
+        justified_cp = Checkpoint(
+            anchor_epoch if anchor_epoch == 0 else anchor_epoch + 1, _hex(anchor_root)
+        )
         proto = ProtoBlock(
             slot=anchor_state.slot,
             block_root=_hex(anchor_root),
             parent_root=_hex(b"\xff" * 32),
             state_root=_hex(bytes(header.state_root)),
             target_root=_hex(anchor_root),
-            justified_epoch=anchor_cp.epoch,
-            justified_root=anchor_cp.root,
-            finalized_epoch=anchor_cp.epoch,
-            finalized_root=anchor_cp.root,
-            unrealized_justified_epoch=anchor_cp.epoch,
-            unrealized_finalized_epoch=anchor_cp.epoch,
+            justified_epoch=justified_cp.epoch,
+            justified_root=justified_cp.root,
+            finalized_epoch=finalized_cp.epoch,
+            finalized_root=finalized_cp.root,
+            unrealized_justified_epoch=justified_cp.epoch,
+            unrealized_finalized_epoch=finalized_cp.epoch,
         )
         self.fork_choice = ForkChoice.from_anchor(
             proto,

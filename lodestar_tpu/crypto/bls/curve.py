@@ -90,18 +90,89 @@ def g1_mul(pt, k: int):
     return g1_mul_raw(pt, k % R)
 
 
-def g1_mul_raw(pt, k: int):
-    """Scalar mul WITHOUT reducing k mod R (for cofactor clearing)."""
-    if k < 0:
-        return g1_mul_raw(g1_neg(pt), -k)
-    result = None
-    addend = pt
+# -- Jacobian ladders ---------------------------------------------------------
+# Scalar multiplication runs inversion-FREE in Jacobian coordinates with a
+# single field inversion at the end: the affine double-and-add above costs
+# one ~381-bit modexp inversion PER STEP (~0.3 ms), which made every
+# hash-to-curve h_eff clearing (~900 steps) and subgroup check take ~0.3 s
+# — the dominant host cost of batch-verify preparation. Formulas:
+# dbl-2009-l and add-2007-bl for a=0 short Weierstrass curves.
+
+
+def _jac_double(X, Y, Z, mul, sq, addf, subf, dbl):
+    A = sq(X)
+    B = sq(Y)
+    C = sq(B)
+    D = dbl(subf(subf(sq(addf(X, B)), A), C))
+    E = addf(dbl(A), A)  # 3A
+    F_ = sq(E)
+    X3 = subf(F_, dbl(D))
+    Y3 = subf(mul(E, subf(D, X3)), dbl(dbl(dbl(C))))  # E(D-X3) - 8C
+    Z3 = dbl(mul(Y, Z))
+    return X3, Y3, Z3
+
+
+def _jac_add(P1, P2, mul, sq, addf, subf, dbl, is_zero):
+    X1, Y1, Z1 = P1
+    X2, Y2, Z2 = P2
+    Z1Z1 = sq(Z1)
+    Z2Z2 = sq(Z2)
+    U1 = mul(X1, Z2Z2)
+    U2 = mul(X2, Z1Z1)
+    S1 = mul(Y1, mul(Z2, Z2Z2))
+    S2 = mul(Y2, mul(Z1, Z1Z1))
+    H = subf(U2, U1)
+    r = dbl(subf(S2, S1))
+    if is_zero(H):
+        if is_zero(r):
+            return _jac_double(X1, Y1, Z1, mul, sq, addf, subf, dbl)
+        return None  # P + (-P) = infinity
+    I = sq(dbl(H))
+    J = mul(H, I)
+    V = mul(U1, I)
+    X3 = subf(subf(sq(r), J), dbl(V))
+    Y3 = subf(mul(r, subf(V, X3)), dbl(mul(S1, J)))
+    Z3 = mul(subf(subf(sq(addf(Z1, Z2)), Z1Z1), Z2Z2), H)
+    return X3, Y3, Z3
+
+
+def _jac_mul(pt_affine, k, one, mul, sq, addf, subf, dbl, is_zero, inv):
+    """Affine point -> affine point*k via a Jacobian double-and-add with
+    one inversion at the end. Returns None for infinity."""
+    acc = None  # Jacobian accumulator, None = infinity
+    add_pt = (pt_affine[0], pt_affine[1], one)
     while k:
         if k & 1:
-            result = g1_add(result, addend)
-        addend = g1_double(addend)
+            acc = add_pt if acc is None else _jac_add(acc, add_pt, mul, sq, addf, subf, dbl, is_zero)
         k >>= 1
-    return result
+        if k:
+            add_pt = _jac_double(*add_pt, mul, sq, addf, subf, dbl)
+    if acc is None or is_zero(acc[2]):
+        return None
+    X, Y, Z = acc
+    zinv = inv(Z)
+    zinv2 = sq(zinv)
+    return mul(X, zinv2), mul(Y, mul(zinv, zinv2))
+
+
+def g1_mul_raw(pt, k: int):
+    """Scalar mul WITHOUT reducing k mod R (for cofactor clearing)."""
+    if pt is None or k == 0:
+        return None
+    if k < 0:
+        return g1_mul_raw(g1_neg(pt), -k)
+    return _jac_mul(
+        pt,
+        k,
+        1,
+        lambda a, b: a * b % P,
+        lambda a: a * a % P,
+        lambda a, b: (a + b) % P,
+        lambda a, b: (a - b) % P,
+        lambda a: 2 * a % P,
+        lambda a: a % P == 0,
+        F.fp_inv,
+    )
 
 
 def g1_in_subgroup(pt) -> bool:
@@ -165,16 +236,24 @@ def g2_add(p1, p2):
 
 
 def g2_mul_raw(pt, k: int):
+    """Scalar mul WITHOUT reducing k mod R (Jacobian ladder, one fp2
+    inversion total — see the G1 ladder note)."""
+    if pt is None or k == 0:
+        return None
     if k < 0:
         return g2_mul_raw(g2_neg(pt), -k)
-    result = None
-    addend = pt
-    while k:
-        if k & 1:
-            result = g2_add(result, addend)
-        addend = g2_double(addend)
-        k >>= 1
-    return result
+    return _jac_mul(
+        pt,
+        k,
+        F.FP2_ONE,
+        F.fp2_mul,
+        F.fp2_sq,
+        F.fp2_add,
+        F.fp2_sub,
+        lambda a: F.fp2_add(a, a),
+        F.fp2_is_zero,
+        F.fp2_inv,
+    )
 
 
 def g2_mul(pt, k: int):

@@ -1,0 +1,73 @@
+"""Checkpoint sync: initialize a beacon node from a trusted provider's
+finalized state instead of replaying from genesis.
+
+Reference `cli/src/cmds/beacon/initBeaconState.ts`
+(fetchWeakSubjectivityState: download the finalized state from a
+trusted beacon API, verify it is within the weak-subjectivity horizon,
+anchor the node on it) — the "wss sync" leg of SURVEY §5
+checkpoint/resume.
+"""
+
+from __future__ import annotations
+
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.params import BeaconPreset, active_preset
+from lodestar_tpu.ssz.json import from_json
+from lodestar_tpu.types import ssz_types
+
+__all__ = ["fetch_checkpoint_state", "CheckpointSyncError"]
+
+# ~54 hours of mainnet epochs; the reference computes the period from
+# validator counts (mainnet lands around 256-665 epochs) — a fixed
+# conservative value keeps the check dependency-free here
+DEFAULT_WSS_EPOCHS = 512
+
+
+class CheckpointSyncError(Exception):
+    pass
+
+
+def fetch_checkpoint_state(
+    client,
+    *,
+    state_id: str = "finalized",
+    p: BeaconPreset | None = None,
+    current_slot: int | None = None,
+    wss_epochs: int = DEFAULT_WSS_EPOCHS,
+):
+    """Download + decode the anchor state from a trusted beacon API.
+
+    `client` is any object with `get_debug_state_v2(state_id) -> dict`
+    (the BeaconApiClient, or an in-process impl for tests). The state is
+    decoded with its own fork's container and gated by the
+    weak-subjectivity horizon when `current_slot` is given."""
+    p = p or active_preset()
+    log = get_logger(name="lodestar.checkpoint_sync")
+    res = client.get_debug_state_v2(state_id)
+    if not isinstance(res, dict) or "data" not in res:
+        raise CheckpointSyncError(f"malformed state response: {type(res)}")
+    fork = res.get("version", "phase0")
+    t = ssz_types(p)
+    ns = getattr(t, fork, None)
+    if ns is None:
+        raise CheckpointSyncError(f"unknown fork version {fork!r}")
+    try:
+        state = from_json(ns.BeaconState, res["data"])
+    except (KeyError, ValueError, TypeError) as e:
+        raise CheckpointSyncError(f"cannot decode {fork} state: {e}") from e
+
+    if current_slot is not None:
+        age_epochs = (int(current_slot) - int(state.slot)) // p.SLOTS_PER_EPOCH
+        if age_epochs > wss_epochs:
+            raise CheckpointSyncError(
+                f"checkpoint state is {age_epochs} epochs old — beyond the "
+                f"weak-subjectivity horizon ({wss_epochs}); refusing to anchor"
+            )
+        if int(state.slot) > int(current_slot):
+            raise CheckpointSyncError("checkpoint state is from the future")
+
+    log.info(
+        "checkpoint state fetched",
+        {"fork": fork, "slot": int(state.slot), "validators": len(state.validators)},
+    )
+    return state

@@ -178,16 +178,19 @@ def _cond_sub_p(x):
 # --- public ops -------------------------------------------------------------
 
 
+@jax.jit
 def add(a, b):
     """(a + b) mod p; canonical in, canonical out."""
     return _cond_sub_p(_carry_full(a + b, passes=2))
 
 
+@jax.jit
 def sub(a, b):
     """(a - b) mod p; canonical in, canonical out."""
     return _cond_sub_p(_carry_full(a + jnp.asarray(P_LIMBS) - b, passes=2))
 
 
+@jax.jit
 def neg(a):
     """(-a) mod p. neg(0) must stay 0, so subtract conditionally."""
     nz = jnp.any(a != 0, axis=-1, keepdims=True)
@@ -226,6 +229,7 @@ def _mont_reduce(t):
     return _cond_sub_p(_carry_full(hi, passes=4))
 
 
+@jax.jit
 def mont_mul(a, b):
     """Montgomery product abR^{-1} mod p; canonical in/out.
 
@@ -242,11 +246,13 @@ def mont_sq(a):
     return mont_mul(a, a)
 
 
+@jax.jit
 def to_mont(a):
     """Standard -> Montgomery form (a * R mod p)."""
     return mont_mul(a, jnp.asarray(R2_LIMBS))
 
 
+@jax.jit
 def from_mont(a):
     """Montgomery -> standard form (a * R^{-1} mod p) via reduction of a."""
     t = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, LIMBS)])

@@ -1,0 +1,127 @@
+"""Checkpoint sync: anchor a second chain from a running node's
+finalized/head state over the REST API, including fork-aware decoding
+and weak-subjectivity gating."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.api.impl import BeaconApiImpl
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.node.checkpoint_sync import CheckpointSyncError, fetch_checkpoint_state
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+
+from ..state_transition.test_state_transition import _empty_block_at
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def _import_chain(p, sks, n_blocks):
+    from lodestar_tpu.state_transition import state_transition
+
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=n_blocks,
+    )
+    state, blocks = genesis, []
+    for slot in range(1, n_blocks + 1):
+        b = _empty_block_at(state, slot, sks, p)
+        blocks.append(b)
+        state = state_transition(state, b, p, verify_signatures=False,
+                                 verify_proposer_signature=False)
+
+    async def go():
+        for b in blocks:
+            await chain.process_block(b)
+
+    asyncio.run(go())
+    return chain
+
+
+def test_checkpoint_sync_in_process_and_over_rest(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    chain = _import_chain(p, sks, 3)
+    impl = BeaconApiImpl(chain)
+
+    # in-process client (the impl satisfies the client protocol)
+    state = fetch_checkpoint_state(impl, state_id="head", p=p, current_slot=5)
+    assert int(state.slot) == 3
+    assert state.type.hash_tree_root(state) == chain.get_head_state().type.hash_tree_root(
+        chain.get_head_state()
+    )
+
+    # a second chain anchored on the fetched state serves its own head
+    chain2 = BeaconChain(
+        anchor_state=state,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=3,
+    )
+    assert chain2.head_root == chain.head_root
+
+    # over real HTTP
+    server = BeaconRestApiServer(impl, port=0)
+    server.start()
+    try:
+
+        class _HttpClient:
+            def get_debug_state_v2(self, state_id):
+                url = f"http://127.0.0.1:{server.port}/eth/v2/debug/beacon/states/{state_id}"
+                with urllib.request.urlopen(url) as r:
+                    return json.loads(r.read())
+
+        state3 = fetch_checkpoint_state(_HttpClient(), state_id="head", p=p, current_slot=5)
+        assert state3.type.hash_tree_root(state3) == state.type.hash_tree_root(state)
+    finally:
+        server.stop()
+
+
+def test_checkpoint_sync_wss_and_malformed_gates(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    chain = _import_chain(p, sks, 2)
+    impl = BeaconApiImpl(chain)
+
+    # too old: beyond the wss horizon
+    far_future = 2 + (10_000 + 1) * p.SLOTS_PER_EPOCH
+    with pytest.raises(CheckpointSyncError, match="weak-subjectivity"):
+        fetch_checkpoint_state(impl, state_id="head", p=p, current_slot=far_future,
+                               wss_epochs=10_000)
+    # future state
+    with pytest.raises(CheckpointSyncError, match="future"):
+        fetch_checkpoint_state(impl, state_id="head", p=p, current_slot=1)
+
+    # malformed provider responses fail closed
+    class _Bad:
+        def get_debug_state_v2(self, state_id):
+            return {"version": "phase9", "data": {}}
+
+    with pytest.raises(CheckpointSyncError, match="unknown fork"):
+        fetch_checkpoint_state(_Bad(), p=p)
+
+    class _Empty:
+        def get_debug_state_v2(self, state_id):
+            return "nope"
+
+    with pytest.raises(CheckpointSyncError, match="malformed"):
+        fetch_checkpoint_state(_Empty(), p=p)
