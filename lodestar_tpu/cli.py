@@ -34,6 +34,11 @@ def _build_parser() -> argparse.ArgumentParser:
     beacon.add_argument("--metrics-port", type=int, default=0)
     beacon.add_argument("--preset", default="mainnet", choices=["minimal", "mainnet"])
     beacon.add_argument("--genesis-validators", type=int, default=64)
+    beacon.add_argument(
+        "--checkpoint-sync-url",
+        default=None,
+        help="trusted beacon API to anchor from (finalized state) instead of a dev genesis",
+    )
 
     sub.add_parser("bench", help="run the device benchmark")
     return ap
@@ -102,9 +107,21 @@ async def _run_beacon(args) -> int:
 
     params.set_active_preset(args.preset)
     p = params.active_preset()
-    genesis = create_interop_genesis_state(args.genesis_validators, p=p)
+    if args.checkpoint_sync_url:
+        import time as _time
+
+        from lodestar_tpu.api.client import BeaconApiClient
+        from lodestar_tpu.node.checkpoint_sync import fetch_checkpoint_state
+
+        client = BeaconApiClient(args.checkpoint_sync_url)
+        genesis_time = int(client.get_genesis()["data"]["genesis_time"])
+        seconds = 12  # mainnet SECONDS_PER_SLOT; dev presets are close enough for the wss gate
+        current_slot = max(0, int(_time.time()) - genesis_time) // seconds
+        anchor = fetch_checkpoint_state(client, p=p, current_slot=current_slot)
+    else:
+        anchor = create_interop_genesis_state(args.genesis_validators, p=p)
     node = await BeaconNode.init(
-        anchor_state=genesis,
+        anchor_state=anchor,
         opts=BeaconNodeOptions(
             db_path=(args.db + "/wal.log") if args.db else None,
             rest_port=args.rest_port,

@@ -261,6 +261,12 @@ def g2_mul(pt, k: int):
 
 
 def g2_in_subgroup(pt) -> bool:
+    """ψ-eigenvalue subgroup membership (order-R ladder retained as
+    g2_in_subgroup_order_check for differential tests)."""
+    return g2_in_subgroup_fast(pt)
+
+
+def g2_in_subgroup_order_check(pt) -> bool:
     return g2_is_on_curve(pt) and g2_mul_raw(pt, R) is None
 
 
@@ -275,6 +281,64 @@ def g1_clear_cofactor(pt):
 
 
 # --- import-time sanity checks --------------------------------------------
+# --- psi endomorphism (G2) ----------------------------------------------------
+# The untwist-Frobenius-twist endomorphism psi on the M-twist: psi(x, y) =
+# (conj(x) * CX, conj(y) * CY) with CX = 1/(1+u)^((p-1)/3),
+# CY = 1/(1+u)^((p-1)/2) — computed from the curve constants at import, no
+# tabulated magic values. Powers the Budroni–Pintore fast cofactor
+# clearing (RFC 9380 App. G.3) and the [x]-eigenvalue subgroup check,
+# replacing 636/255-bit scalar ladders with 64-bit ones.
+
+_PSI_CX = F.fp2_pow(F.fp2_inv((1, 1)), (P - 1) // 3)
+_PSI_CY = F.fp2_pow(F.fp2_inv((1, 1)), (P - 1) // 2)
+
+
+def g2_psi(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return (F.fp2_mul(F.fp2_conj(x), _PSI_CX), F.fp2_mul(F.fp2_conj(y), _PSI_CY))
+
+
+def g2_psi2(pt):
+    return g2_psi(g2_psi(pt))
+
+
+def g2_clear_cofactor_fast(pt):
+    """Budroni–Pintore clearing: [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P),
+    identical output to [h_eff]P (differentially pinned in
+    tests/crypto test_psi_fast_paths_match_slow). c1 = -x = |BLS_X|
+    since x < 0."""
+    if pt is None:
+        return None
+    c1 = -BLS_X  # positive
+    t1 = g2_neg(g2_mul_raw(pt, c1))  # [x]P
+    t2 = g2_psi(pt)
+    t3 = g2_psi2(g2_double(pt))  # psi^2([2]P)
+    t3 = g2_add(t3, g2_neg(t2))  # psi^2(2P) - psi(P)
+    t2 = g2_add(t1, t2)  # [x]P + psi(P)
+    t2 = g2_neg(g2_mul_raw(t2, c1))  # [x]([x]P + psi(P))
+    t3 = g2_add(t3, t2)
+    t3 = g2_add(t3, g2_neg(t1))  # - [x]P
+    return g2_add(t3, g2_neg(pt))  # - P
+
+
+def g2_in_subgroup_fast(pt) -> bool:
+    """[x]-eigenvalue check: P on the twist is in G2 iff psi(P) == [x]P
+    (pinned against the order-R check in the differential tests; the
+    eigenvalue itself is asserted at import)."""
+    if pt is None:
+        return True
+    if not g2_is_on_curve(pt):
+        return False
+    return g2_eq(g2_psi(pt), g2_mul_raw(pt, BLS_X))
+
+
+# import-time self-checks pinning the psi constants to the slow paths
+assert g2_eq(g2_psi(G2_GEN), g2_mul_raw(G2_GEN, BLS_X))  # eigenvalue = x
+assert g2_in_subgroup_fast(g2_mul_raw(G2_GEN, 12345))
+
+
 assert g1_is_on_curve(G1_GEN), "G1 generator not on curve"
 assert g2_is_on_curve(G2_GEN), "G2 generator not on twist"
 assert g1_in_subgroup(G1_GEN), "G1 generator wrong order"

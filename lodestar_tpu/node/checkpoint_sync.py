@@ -34,15 +34,24 @@ def fetch_checkpoint_state(
     p: BeaconPreset | None = None,
     current_slot: int | None = None,
     wss_epochs: int = DEFAULT_WSS_EPOCHS,
+    allow_stale: bool = False,
 ):
     """Download + decode the anchor state from a trusted beacon API.
 
     `client` is any object with `get_debug_state_v2(state_id) -> dict`
     (the BeaconApiClient, or an in-process impl for tests). The state is
     decoded with its own fork's container and gated by the
-    weak-subjectivity horizon when `current_slot` is given."""
+    weak-subjectivity horizon. The gate is opt-OUT: callers must supply
+    `current_slot` (or explicitly pass allow_stale=True) — silently
+    skipping the wss check is exactly the long-range-attack door this
+    module exists to close."""
     p = p or active_preset()
     log = get_logger(name="lodestar.checkpoint_sync")
+    if current_slot is None and not allow_stale:
+        raise CheckpointSyncError(
+            "current_slot is required for the weak-subjectivity check "
+            "(pass allow_stale=True to explicitly skip it)"
+        )
     res = client.get_debug_state_v2(state_id)
     if not isinstance(res, dict) or "data" not in res:
         raise CheckpointSyncError(f"malformed state response: {type(res)}")

@@ -299,3 +299,23 @@ class TestEthAggregateSemantics:
         agg = bls.aggregate_signatures([bls.sign(sk, msg) for sk in sks])
         pks = [bls.sk_to_pk(sk) for sk in sks]
         assert bls.eth_fast_aggregate_verify(pks, msg, agg)
+
+
+def test_psi_fast_paths_match_slow():
+    """ψ-based cofactor clearing and subgroup check are byte-identical to
+    the [h_eff]/order-R ladders on SSWU outputs (members AND twist
+    points outside G2)."""
+    from lodestar_tpu.crypto.bls import curve as C
+    from lodestar_tpu.crypto.bls import hash_to_curve as H
+
+    for seed in range(4):
+        u = H.hash_to_field_fp2(bytes([seed]) * 32, 2)
+        q = C.g2_add(H.map_to_curve_g2(u[0]), H.map_to_curve_g2(u[1]))
+        assert C.g2_eq(C.g2_clear_cofactor_fast(q), C.g2_mul_raw(q, H.H_EFF))
+        assert C.g2_in_subgroup_fast(q) == C.g2_in_subgroup_order_check(q)
+        cleared = C.g2_clear_cofactor_fast(q)
+        assert C.g2_in_subgroup_fast(cleared)
+        assert C.g2_in_subgroup_order_check(cleared)
+    # infinity and non-curve points
+    assert C.g2_in_subgroup_fast(None)
+    assert not C.g2_in_subgroup_fast((C.G2_GEN[0], C.G2_GEN[0]))

@@ -117,11 +117,15 @@ def test_checkpoint_sync_wss_and_malformed_gates(minimal_preset):
             return {"version": "phase9", "data": {}}
 
     with pytest.raises(CheckpointSyncError, match="unknown fork"):
-        fetch_checkpoint_state(_Bad(), p=p)
+        fetch_checkpoint_state(_Bad(), p=p, allow_stale=True)
 
     class _Empty:
         def get_debug_state_v2(self, state_id):
             return "nope"
 
     with pytest.raises(CheckpointSyncError, match="malformed"):
-        fetch_checkpoint_state(_Empty(), p=p)
+        fetch_checkpoint_state(_Empty(), p=p, allow_stale=True)
+
+    # the wss gate is opt-out: omitting current_slot without allow_stale fails
+    with pytest.raises(CheckpointSyncError, match="current_slot is required"):
+        fetch_checkpoint_state(impl, p=p)
