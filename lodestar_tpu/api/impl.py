@@ -31,6 +31,86 @@ class BeaconApiImpl:
         self.p = chain.p
         self.t = ssz_types(chain.p)
 
+    # -- events namespace (SSE) -----------------------------------------------
+
+    def stream_events(self, topics: list[str]) -> "EventStream":
+        import queue as _queue
+
+        for t in topics:
+            if t not in EVENT_TOPICS:
+                raise ApiError(400, f"unknown event topic {t!r}")
+        if not topics:
+            topics = list(EVENT_TOPICS)
+        q: "_queue.Queue" = _queue.Queue(maxsize=1024)
+        chain = self.chain
+
+        def _put(event_type: str, payload: dict) -> None:
+            try:
+                q.put_nowait((event_type, payload))
+            except _queue.Full:
+                pass  # slow consumer: drop rather than stall the chain
+
+        handlers = []
+        if "block" in topics:
+
+            def on_block(root, signed):
+                _put(
+                    "block",
+                    {
+                        "slot": str(int(signed.message.slot)),
+                        "block": "0x" + bytes(root).hex(),
+                        "execution_optimistic": False,
+                    },
+                )
+
+            chain.on("block", on_block)
+            handlers.append(("block", on_block))
+        if "head" in topics:
+            prev_epoch = [int(chain.fork_choice.current_slot) // chain.p.SLOTS_PER_EPOCH]
+
+            def on_head(head_hex):
+                node = chain.fork_choice.proto_array.get_block(head_hex)
+                epoch = (node.slot if node else 0) // chain.p.SLOTS_PER_EPOCH
+                transition = epoch != prev_epoch[0]
+                prev_epoch[0] = epoch
+                _put(
+                    "head",
+                    {
+                        "slot": str(node.slot if node else 0),
+                        "block": head_hex,
+                        "state": node.state_root if node else "0x" + "00" * 32,
+                        "epoch_transition": transition,
+                        "execution_optimistic": False,
+                    },
+                )
+
+            chain.on("head", on_head)
+            handlers.append(("head", on_head))
+        if "finalized_checkpoint" in topics:
+
+            def on_finalized(cp):
+                node = chain.fork_choice.proto_array.get_block("0x" + bytes(cp.root).hex())
+                _put(
+                    "finalized_checkpoint",
+                    {
+                        "block": "0x" + bytes(cp.root).hex(),
+                        "state": node.state_root if node else "0x" + "00" * 32,
+                        "epoch": str(int(cp.epoch)),
+                        "execution_optimistic": False,
+                    },
+                )
+
+            chain.on("finalized", on_finalized)
+            handlers.append(("finalized", on_finalized))
+
+        def unsubscribe():
+            for event, fn in handlers:
+                chain.off(event, fn)
+
+        return EventStream(q, unsubscribe)
+
+
+
     # -- state resolution -----------------------------------------------------
 
     def _state_at(self, state_id: str):
@@ -316,3 +396,25 @@ def _validator_status(v, epoch: int) -> str:
     if epoch < v.withdrawable_epoch:
         return "exited_slashed" if v.slashed else "exited_unslashed"
     return "withdrawal_possible"
+
+
+# --- events namespace (SSE) ---------------------------------------------------
+# Reference `beacon-node/src/api/impl/events/index.ts`: subscribe chain
+# emitter topics, forward as Server-Sent Events. The REST server streams
+# an EventStream return value instead of JSON-encoding it.
+
+EVENT_TOPICS = ("head", "block", "finalized_checkpoint")
+
+
+class EventStream:
+    """Thread-safe queue of (event_type, payload_dict) fed by chain
+    events; the HTTP handler drains it as an SSE body. `close()`
+    detaches the chain subscriptions."""
+
+    def __init__(self, queue, unsubscribe):
+        self.queue = queue
+        self._unsubscribe = unsubscribe
+
+    def close(self) -> None:
+        self._unsubscribe()
+

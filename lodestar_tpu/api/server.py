@@ -31,6 +31,7 @@ ROUTES: list[tuple[str, str, str]] = [
     ("POST", r"/eth/v1/validator/duties/attester/(?P<epoch>\d+)", "r_attester_duties"),
     ("GET", r"/eth/v2/validator/blocks/(?P<slot>\d+)", "r_produce_block"),
     ("GET", r"/eth/v1/validator/attestation_data", "r_attestation_data"),
+    ("GET", r"/eth/v1/events", "r_events"),
     ("GET", r"/eth/v1/node/health", "r_health"),
     ("GET", r"/eth/v1/node/version", "r_version"),
     ("GET", r"/eth/v1/node/syncing", "r_syncing"),
@@ -98,6 +99,10 @@ class _Router:
             int(query["slot"]), int(query["committee_index"])
         )
 
+    def r_events(self, query, **kw):
+        topics = [t for t in (query.get("topics") or "").split(",") if t]
+        return self.api.stream_events(topics)
+
     def r_health(self, **kw):
         return self.api.get_health()
 
@@ -159,7 +164,39 @@ class RestServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
+                from lodestar_tpu.api.impl import EventStream
+
+                if isinstance(out, EventStream):
+                    self._stream_sse(out)
+                    return
                 self._reply(200, json.dumps(out).encode())
+
+            def _stream_sse(self, stream):
+                """Server-Sent Events: drain the stream's queue until the
+                client disconnects; periodic keepalive comments."""
+                import queue as _queue
+
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                try:
+                    while True:
+                        try:
+                            event_type, payload = stream.queue.get(timeout=10.0)
+                        except _queue.Empty:
+                            self.wfile.write(b": keepalive\n\n")
+                            self.wfile.flush()
+                            continue
+                        frame = (
+                            f"event: {event_type}\ndata: {json.dumps(payload)}\n\n".encode()
+                        )
+                        self.wfile.write(frame)
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # client went away
+                finally:
+                    stream.close()
 
             def _reply(self, status, payload: bytes):
                 self.send_response(status)

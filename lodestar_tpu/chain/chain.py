@@ -203,8 +203,15 @@ class BeaconChain:
     def on(self, event: str, fn: Callable) -> None:
         self._subscribers[event].append(fn)
 
+    def off(self, event: str, fn: Callable) -> None:
+        """Detach a subscriber (safe from other threads — _emit iterates
+        a snapshot, so concurrent removal never skips a neighbor)."""
+        subs = self._subscribers.get(event, [])
+        if fn in subs:
+            subs.remove(fn)
+
     def _emit(self, event: str, *args) -> None:
-        for fn in self._subscribers.get(event, ()):
+        for fn in tuple(self._subscribers.get(event, ())):
             fn(*args)
 
     # -- clock ----------------------------------------------------------------

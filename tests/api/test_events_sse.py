@@ -1,0 +1,110 @@
+"""SSE events endpoint: chain events stream to an HTTP consumer as
+Server-Sent Events (reference api/impl/events + routes.events)."""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import threading
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.api.impl import ApiError, BeaconApiImpl
+from lodestar_tpu.api.server import BeaconRestApiServer
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+
+from ..state_transition.test_state_transition import _empty_block_at
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def test_stream_events_queue_level(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis, bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(), current_slot=2,
+    )
+    impl = BeaconApiImpl(chain)
+    with pytest.raises(ApiError):
+        impl.stream_events(["nonsense_topic"])
+
+    stream = impl.stream_events(["head", "block"])
+    signed = _empty_block_at(genesis, 1, sks, p)
+    asyncio.run(chain.process_block(signed))
+
+    events = {}
+    while not stream.queue.empty():
+        etype, payload = stream.queue.get_nowait()
+        events[etype] = payload
+    assert events["block"]["slot"] == "1"
+    assert events["head"]["block"].startswith("0x")
+    stream.close()
+    # detached: further imports don't enqueue
+    signed2 = _empty_block_at(
+        chain.get_head_state(), 2, sks, p
+    )
+    asyncio.run(chain.process_block(signed2))
+    assert stream.queue.empty()
+
+
+def test_sse_over_http(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis, bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(), current_slot=2,
+    )
+    server = BeaconRestApiServer(BeaconApiImpl(chain), port=0)
+    server.start()
+    got = {}
+    ready = threading.Event()
+    done = threading.Event()
+
+    def consume():
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=15)
+        conn.request("GET", "/eth/v1/events?topics=block")
+        resp = conn.getresponse()
+        got["content_type"] = resp.getheader("Content-Type")
+        ready.set()
+        buf = b""
+        while b"\n\n" not in buf or buf.strip().startswith(b":"):
+            chunk = resp.read1(4096)
+            if not chunk:
+                break
+            buf += chunk
+            if b"event: block" in buf and buf.endswith(b"\n\n"):
+                break
+        got["body"] = buf
+        conn.close()
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert ready.wait(10), "SSE response never started"
+    signed = _empty_block_at(genesis, 1, sks, p)
+    asyncio.run(chain.process_block(signed))
+    assert done.wait(15), "SSE frame never arrived"
+    server.stop()
+
+    assert got["content_type"] == "text/event-stream"
+    body = got["body"].decode()
+    assert "event: block" in body
+    data_line = [ln for ln in body.splitlines() if ln.startswith("data: ")][0]
+    payload = json.loads(data_line[6:])
+    assert payload["slot"] == "1"
