@@ -66,7 +66,11 @@ class BeaconApiImpl:
             chain.on("block", on_block)
             handlers.append(("block", on_block))
         if "head" in topics:
-            prev_epoch = [int(chain.fork_choice.current_slot) // chain.p.SLOTS_PER_EPOCH]
+            # baseline from the CURRENT HEAD's slot, not the wall clock: a
+            # syncing node's clock epoch is far ahead of its head epoch and
+            # would fire a spurious epoch_transition on the first event
+            head_node = chain.fork_choice.proto_array.get_block(chain.fork_choice.head)
+            prev_epoch = [(head_node.slot if head_node else 0) // chain.p.SLOTS_PER_EPOCH]
 
             def on_head(head_hex):
                 node = chain.fork_choice.proto_array.get_block(head_hex)

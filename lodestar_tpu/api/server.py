@@ -13,7 +13,7 @@ import re
 import threading
 from typing import Callable
 
-from .impl import ApiError, BeaconApiImpl
+from .impl import ApiError, BeaconApiImpl, EventStream
 
 __all__ = ["BeaconRestApiServer", "ROUTES"]
 
@@ -130,12 +130,14 @@ class RestServer:
         self.port = port
         self._httpd = None
         self._thread: threading.Thread | None = None
+        self._sse_streams: set = set()  # live EventStreams, closed on stop()
 
     def start(self) -> None:
         import http.server
         from urllib.parse import parse_qsl, urlsplit
 
         router = self.router
+        outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def _run(self, method):
@@ -164,8 +166,6 @@ class RestServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                from lodestar_tpu.api.impl import EventStream
-
                 if isinstance(out, EventStream):
                     self._stream_sse(out)
                     return
@@ -173,21 +173,26 @@ class RestServer:
 
             def _stream_sse(self, stream):
                 """Server-Sent Events: drain the stream's queue until the
-                client disconnects; periodic keepalive comments."""
+                client disconnects or the server shuts down (None
+                sentinel); periodic keepalive comments."""
                 import queue as _queue
 
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.end_headers()
+                outer._sse_streams.add(stream)
                 try:
                     while True:
                         try:
-                            event_type, payload = stream.queue.get(timeout=10.0)
+                            item = stream.queue.get(timeout=10.0)
                         except _queue.Empty:
                             self.wfile.write(b": keepalive\n\n")
                             self.wfile.flush()
                             continue
+                        if item is None:  # shutdown sentinel from stop()
+                            break
+                        event_type, payload = item
                         frame = (
                             f"event: {event_type}\ndata: {json.dumps(payload)}\n\n".encode()
                         )
@@ -196,6 +201,7 @@ class RestServer:
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass  # client went away
                 finally:
+                    outer._sse_streams.discard(stream)
                     stream.close()
 
             def _reply(self, status, payload: bytes):
@@ -223,6 +229,14 @@ class RestServer:
         self._thread.start()
 
     def stop(self) -> None:
+        # unblock live SSE handlers first: detach chain subscriptions and
+        # push the shutdown sentinel so their queue.get returns now
+        for stream in list(self._sse_streams):
+            stream.close()
+            try:
+                stream.queue.put_nowait(None)
+            except Exception:
+                pass
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -39,6 +39,21 @@ class ReqRespBeaconNode(ReqResp):
             self._on_blocks_by_root,
             quota=RateLimiterQuota(128, 10.0),
         )
+        self.register_handler(_pid("goodbye"), self._on_goodbye)
+        # light-client protocols (reference reqresp/protocols.ts
+        # LightClientBootstrap/UpdatesByRange/FinalityUpdate/OptimisticUpdate)
+        self.register_handler(
+            _pid("light_client_bootstrap"),
+            self._on_lc_bootstrap,
+            quota=RateLimiterQuota(16, 10.0),
+        )
+        self.register_handler(
+            _pid("light_client_updates_by_range"),
+            self._on_lc_updates_by_range,
+            quota=RateLimiterQuota(16, 10.0),
+        )
+        self.register_handler(_pid("light_client_finality_update"), self._on_lc_finality)
+        self.register_handler(_pid("light_client_optimistic_update"), self._on_lc_optimistic)
 
     # -- handlers -------------------------------------------------------------
 
@@ -88,3 +103,46 @@ class ReqRespBeaconNode(ReqResp):
             signed = self.chain.get_block_by_root(bytes(root))
             if signed is not None:
                 yield signed
+
+    async def _on_goodbye(self, req, peer):
+        yield 0  # GoodbyeReason: client shutdown acknowledgment
+
+    # -- light-client protocols ------------------------------------------------
+
+    def _lc(self):
+        server = getattr(self.chain, "light_client_server", None)
+        if server is None:
+            raise ReqRespError("light-client server not enabled")
+        return server
+
+    async def _on_lc_bootstrap(self, req, peer):
+        from lodestar_tpu.chain.chain import BlockError
+
+        try:
+            bootstrap = self._lc().get_bootstrap(bytes(req))
+        except (BlockError, KeyError) as e:
+            raise ReqRespError(f"unknown bootstrap checkpoint root: {e}") from e
+        if bootstrap is None:
+            raise ReqRespError("unknown bootstrap checkpoint root")
+        yield bootstrap
+
+    MAX_LIGHT_CLIENT_UPDATES = 128  # spec MAX_REQUEST_LIGHT_CLIENT_UPDATES
+
+    async def _on_lc_updates_by_range(self, req, peer):
+        # clamp the peer-supplied u64 BEFORE get_updates materializes a
+        # range over it — an unclamped 2^64 count would spin the event loop
+        count = min(int(req.count), self.MAX_LIGHT_CLIENT_UPDATES)
+        for update in self._lc().get_updates(int(req.start_period), count):
+            yield update
+
+    async def _on_lc_finality(self, req, peer):
+        update = self._lc().get_finality_update()
+        if update is None:
+            raise ReqRespError("no finality update available")
+        yield update
+
+    async def _on_lc_optimistic(self, req, peer):
+        update = self._lc().get_optimistic_update()
+        if update is None:
+            raise ReqRespError("no optimistic update available")
+        yield update

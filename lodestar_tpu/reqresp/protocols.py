@@ -52,6 +52,23 @@ BEACON_PROTOCOLS: dict[str, Protocol] = {
             lambda: _t().phase0.SignedBeaconBlock,
             1024,
         ),
+        # light-client protocols (reference protocols.ts LightClient*)
+        Protocol(
+            _pid("light_client_bootstrap"),
+            lambda: ssz.Bytes32,
+            lambda: _t().LightClientBootstrap,
+            1,
+        ),
+        Protocol(
+            _pid("light_client_updates_by_range"),
+            lambda: _t().LightClientUpdatesByRange,
+            lambda: _t().LightClientUpdate,
+            128,
+        ),
+        Protocol(_pid("light_client_finality_update"), None, lambda: _t().LightClientFinalityUpdate, 1),
+        Protocol(
+            _pid("light_client_optimistic_update"), None, lambda: _t().LightClientOptimisticUpdate, 1
+        ),
     ]
 }
 

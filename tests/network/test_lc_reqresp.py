@@ -1,0 +1,108 @@
+"""Light-client req/resp protocols over real TCP: bootstrap, updates by
+range, finality + optimistic updates served from the chain's
+LightClientServer (reference reqresp/protocols.ts LightClient*)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.light_client_server import LightClientServer
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.network.reqresp_node import ReqRespBeaconNode
+from lodestar_tpu.reqresp import ReqResp
+from lodestar_tpu.state_transition.altair import upgrade_to_altair
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.types import ssz_types
+
+from ..light_client.test_server import _altair_block
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def _pid(name):
+    return f"/eth2/beacon_chain/req/{name}/1/ssz_snappy"
+
+
+def test_light_client_protocols_over_tcp(minimal_preset):
+    p = minimal_preset
+    far = 2**64 - 1
+    cfg = minimal_chain_config().replace(
+        ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far
+    )
+    sks = interop_secret_keys(N)
+    genesis = upgrade_to_altair(
+        create_interop_genesis_state(N, p=p, genesis_fork_version=cfg.GENESIS_FORK_VERSION), cfg, p
+    )
+    t = ssz_types(p)
+
+    chain = BeaconChain(
+        anchor_state=genesis, bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(), cfg=cfg, current_slot=3,
+    )
+    chain.light_client_server = LightClientServer(chain)
+
+    async def go():
+        state = genesis
+        roots = []
+        for slot in (1, 2, 3):
+            signed = _altair_block(state, slot, sks, p, cfg)
+            await chain.process_block(signed)
+            roots.append(t.altair.BeaconBlock.hash_tree_root(signed.message))
+            state = chain.get_head_state()
+
+        node = ReqRespBeaconNode(chain)
+        server = await asyncio.start_server(
+            lambda r, w: node.handle_stream(r, w, "client"), "127.0.0.1", 0
+        )
+        port = server.sockets[0].getsockname()[1]
+
+        async def dial():
+            return await asyncio.open_connection("127.0.0.1", port)
+
+        client = ReqResp()
+
+        # bootstrap at a known block root
+        boots = await client.send_request(dial, _pid("light_client_bootstrap"), roots[-1])
+        assert len(boots) == 1
+        assert int(boots[0].header.beacon.slot) == 3
+        assert len(boots[0].current_sync_committee.pubkeys) == p.SYNC_COMMITTEE_SIZE
+
+        # updates by range
+        req = t.LightClientUpdatesByRange.default()
+        req.start_period = 0
+        req.count = 2
+        updates = await client.send_request(dial, _pid("light_client_updates_by_range"), req)
+        assert updates, "no updates served"
+
+        # optimistic update works pre-finality; the finality update
+        # correctly errors on an unfinalized chain (clean error chunk)
+        from lodestar_tpu.reqresp.reqresp import ResponseError
+
+        opt = await client.send_request(dial, _pid("light_client_optimistic_update"), None)
+        assert int(opt[0].attested_header.beacon.slot) >= 1
+        with pytest.raises(ResponseError, match="finality"):
+            await client.send_request(dial, _pid("light_client_finality_update"), None)
+
+        # unknown bootstrap root -> error chunk, not a hang
+        with pytest.raises(ResponseError):
+            await client.send_request(dial, _pid("light_client_bootstrap"), b"\x99" * 32)
+
+        # no wait_closed(): 3.12 waits for in-flight handlers, and the
+        # error-path client connections are still open at this point
+        server.close()
+
+    asyncio.run(go())
