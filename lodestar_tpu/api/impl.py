@@ -352,6 +352,26 @@ class BeaconApiImpl:
         data = make_attestation_data(self.chain, slot, committee_index)
         return {"data": to_json(self.t.AttestationData, data)}
 
+    def get_validator_liveness(self, epoch: int, indices: list[int]) -> dict:
+        """POST /eth/v1/validator/liveness/{epoch}: whether each index
+        showed on-chain activity in the epoch (doppelganger data source;
+        the reference reads its validator monitor — here the seen-attester
+        cache carries the same signal)."""
+        chain = self.chain
+
+        def is_live(i: int) -> bool:
+            return (
+                chain.seen_attesters.is_known(int(epoch), i)
+                or chain.seen_aggregators.is_known(int(epoch), i)
+                or chain.seen_block_proposers.is_known(int(epoch), i)
+            )
+
+        return {
+            "data": [
+                {"index": str(int(i)), "is_live": bool(is_live(int(i)))} for i in indices
+            ]
+        }
+
     # -- node namespace -------------------------------------------------------
 
     def get_health(self) -> int:

@@ -31,6 +31,7 @@ ROUTES: list[tuple[str, str, str]] = [
     ("POST", r"/eth/v1/validator/duties/attester/(?P<epoch>\d+)", "r_attester_duties"),
     ("GET", r"/eth/v2/validator/blocks/(?P<slot>\d+)", "r_produce_block"),
     ("GET", r"/eth/v1/validator/attestation_data", "r_attestation_data"),
+    ("POST", r"/eth/v1/validator/liveness/(?P<epoch>\d+)", "r_liveness"),
     ("GET", r"/eth/v1/events", "r_events"),
     ("GET", r"/eth/v1/node/health", "r_health"),
     ("GET", r"/eth/v1/node/version", "r_version"),
@@ -99,6 +100,9 @@ class _Router:
             int(query["slot"]), int(query["committee_index"])
         )
 
+    def r_liveness(self, epoch, body, **kw):
+        return self.api.get_validator_liveness(int(epoch), [int(i) for i in (body or [])])
+
     def r_events(self, query, **kw):
         topics = [t for t in (query.get("topics") or "").split(",") if t]
         return self.api.stream_events(topics)
@@ -131,6 +135,7 @@ class RestServer:
         self._httpd = None
         self._thread: threading.Thread | None = None
         self._sse_streams: set = set()  # live EventStreams, closed on stop()
+        self._closing = False
 
     def start(self) -> None:
         import http.server
@@ -183,7 +188,7 @@ class RestServer:
                 self.end_headers()
                 outer._sse_streams.add(stream)
                 try:
-                    while True:
+                    while not outer._closing:
                         try:
                             item = stream.queue.get(timeout=10.0)
                         except _queue.Empty:
@@ -229,8 +234,10 @@ class RestServer:
         self._thread.start()
 
     def stop(self) -> None:
-        # unblock live SSE handlers first: detach chain subscriptions and
-        # push the shutdown sentinel so their queue.get returns now
+        # unblock live SSE handlers: the closing flag covers handlers the
+        # sentinel can't reach (race before _sse_streams.add, full queue)
+        # within one keepalive interval; the sentinel covers the rest now
+        self._closing = True
         for stream in list(self._sse_streams):
             stream.close()
             try:

@@ -141,6 +141,10 @@ class BeaconChain:
         # optional light-client server (chain.light_client_server)
         self.light_client_server = None
         self.seen_attesters = SeenAttesters()
+        from .op_pools import SeenAggregators, _EpochKeyedSet
+
+        self.seen_aggregators = SeenAggregators()
+        self.seen_block_proposers = _EpochKeyedSet()
 
         # anchor: latest block header of the anchor state defines the root
         header = anchor_state.latest_block_header.copy()
@@ -378,12 +382,18 @@ class BeaconChain:
             justified_balances=effective_balances_array(post_state),
         )
 
-        # operation attestations feed LMD votes (importBlock.ts:130)
+        # operation attestations feed LMD votes (importBlock.ts:130) and
+        # the liveness record (doppelganger data source: on-chain activity
+        # counts, not just gossip — reference validatorMonitor)
+        blk_proposer_epoch = compute_epoch_at_slot(block.slot, self.p)
+        self.seen_block_proposers.add(blk_proposer_epoch, int(block.proposer_index))
         for att in block.body.attestations:
             try:
                 attesting = ctx.get_attesting_indices(att.data, att.aggregation_bits)
             except ValueError:
                 continue
+            for i in attesting:
+                self.seen_attesters.add(int(att.data.target.epoch), int(i))
             self.fork_choice.on_attestation(
                 [int(i) for i in attesting],
                 _hex(bytes(att.data.beacon_block_root)),

@@ -126,12 +126,15 @@ class ReqRespBeaconNode(ReqResp):
             raise ReqRespError("unknown bootstrap checkpoint root")
         yield bootstrap
 
-    MAX_LIGHT_CLIENT_UPDATES = 128  # spec MAX_REQUEST_LIGHT_CLIENT_UPDATES
-
     async def _on_lc_updates_by_range(self, req, peer):
         # clamp the peer-supplied u64 BEFORE get_updates materializes a
-        # range over it — an unclamped 2^64 count would spin the event loop
-        count = min(int(req.count), self.MAX_LIGHT_CLIENT_UPDATES)
+        # range over it — an unclamped 2^64 count would spin the event
+        # loop. The limit is the protocol table's chunk cap (the spec's
+        # MAX_REQUEST_LIGHT_CLIENT_UPDATES), declared once.
+        from lodestar_tpu.reqresp.protocols import protocol_by_id
+
+        cap = protocol_by_id(_pid("light_client_updates_by_range")).max_response_chunks
+        count = min(int(req.count), cap)
         for update in self._lc().get_updates(int(req.start_period), count):
             yield update
 

@@ -23,10 +23,26 @@ class Validator:
     getDevBeaconNode pattern): on each slot — propose if selected, attest
     at the committee assignment."""
 
-    def __init__(self, *, chain, store: ValidatorStore, p: BeaconPreset | None = None):
+    def __init__(
+        self,
+        *,
+        chain,
+        store: ValidatorStore,
+        p: BeaconPreset | None = None,
+        doppelganger=None,
+    ):
         self.chain = chain
         self.store = store
         self.p = p or active_preset()
+        self.doppelganger = doppelganger
+
+    def _may_sign(self, pubkey: bytes) -> bool:
+        """Key is managed AND (when doppelganger protection is on) has
+        cleared its detection window (reference validatorStore
+        isDoppelgangerSafe gate on every signing path)."""
+        if not self.store.has_pubkey(pubkey):
+            return False
+        return self.doppelganger is None or self.doppelganger.is_safe(pubkey)
 
     async def run_slot_duties(self, slot: int) -> dict:
         """Propose + attest for `slot`. Returns a summary of what was
@@ -40,7 +56,7 @@ class Validator:
         # -- proposal (services/block.ts) --
         proposer_index = ctx.get_beacon_proposer(slot)
         proposer_pk = bytes(work.validators[proposer_index].pubkey)
-        if self.store.has_pubkey(proposer_pk):
+        if self._may_sign(proposer_pk):
             from lodestar_tpu.chain.produce_block import produce_block
 
             epoch = slot // self.p.SLOTS_PER_EPOCH
@@ -70,7 +86,7 @@ class Validator:
             data_root = t.AttestationData.hash_tree_root(data)
             for pos, vi in enumerate(committee):
                 pk = bytes(work.validators[int(vi)].pubkey)
-                if not self.store.has_pubkey(pk):
+                if not self._may_sign(pk):
                     continue
                 try:
                     sig = self.store.sign_attestation(pk, data)
@@ -126,7 +142,7 @@ class Validator:
         messages = []
         vi_by_pk = ctx.pubkey_to_index(work)  # cached on the context
         for pos, pk in enumerate(committee_pks):
-            if not self.store.has_pubkey(pk):
+            if not self._may_sign(pk):
                 continue
             subnet = pos // sub_size
             msg = t.SyncCommitteeMessage.default()
@@ -144,7 +160,7 @@ class Validator:
         for subnet in range(SYNC_COMMITTEE_SUBNET_COUNT):
             window = committee_pks[subnet * sub_size : (subnet + 1) * sub_size]
             for pk in window:
-                if not self.store.has_pubkey(pk):
+                if not self._may_sign(pk):
                     continue
                 try:
                     proof = self.store.sign_sync_selection_proof(pk, slot, subnet)
@@ -178,7 +194,7 @@ class Validator:
             committee = ctx.get_beacon_committee(slot, committee_index)
             for vi in committee:
                 pk = bytes(work.validators[int(vi)].pubkey)
-                if not self.store.has_pubkey(pk):
+                if not self._may_sign(pk):
                     continue
                 try:
                     proof = self.store.sign_selection_proof(pk, slot)

@@ -1,0 +1,136 @@
+"""Doppelganger protection: detection windows, liveness-driven
+blocking, the validator signing gate, and the liveness REST endpoint."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.validator.doppelganger import (
+    DoppelgangerService,
+    DoppelgangerStatus,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+PK_A = b"\xa1" * 48
+PK_B = b"\xb2" * 48
+
+
+def test_detection_window_and_safety():
+    svc = DoppelgangerService(detection_epochs=2)
+    svc.register_validator(PK_A, current_epoch=5)
+    assert svc.status(PK_A) == DoppelgangerStatus.UNVERIFIED
+    assert not svc.is_safe(PK_A)
+    # unknown keys are safe (not enrolled)
+    assert svc.is_safe(PK_B)
+
+    # registration epoch itself does not count
+    svc.on_epoch_liveness(5, {PK_A: False})
+    assert svc.status(PK_A) == DoppelgangerStatus.UNVERIFIED
+    # two quiet epochs clear the key
+    svc.on_epoch_liveness(6, {PK_A: False})
+    svc.on_epoch_liveness(7, {PK_A: False})
+    assert svc.status(PK_A) == DoppelgangerStatus.VERIFIED_SAFE
+    assert svc.is_safe(PK_A)
+
+
+def test_activity_blocks_key_permanently():
+    svc = DoppelgangerService(detection_epochs=2)
+    svc.register_validator(PK_A, current_epoch=3)
+    detected = svc.on_epoch_liveness(4, {PK_A: True})
+    assert detected == [PK_A]
+    assert svc.status(PK_A) == DoppelgangerStatus.DETECTED
+    assert not svc.is_safe(PK_A)
+    assert svc.detected == [PK_A]
+    # further quiet epochs never rehabilitate it
+    svc.on_epoch_liveness(5, {PK_A: False})
+    assert svc.status(PK_A) == DoppelgangerStatus.DETECTED
+
+
+def test_genesis_registration_skips_detection():
+    svc = DoppelgangerService()
+    svc.register_validator(PK_A, current_epoch=0)
+    assert svc.status(PK_A) == DoppelgangerStatus.VERIFIED_SAFE
+
+
+def test_validator_gate_blocks_unverified_keys(minimal_preset):
+    """A validator with doppelganger protection produces nothing until
+    its keys clear the window."""
+    from lodestar_tpu.chain.bls import BlsVerifierMock
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.config import create_beacon_config, minimal_chain_config
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.state_transition.genesis import (
+        create_interop_genesis_state,
+        interop_secret_keys,
+    )
+    from lodestar_tpu.validator import SlashingProtection, Validator, ValidatorStore
+
+    p = minimal_preset
+    sks = interop_secret_keys(16)
+    genesis = create_interop_genesis_state(16, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis, bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(), current_slot=1,
+    )
+    cfg = create_beacon_config(minimal_chain_config(), bytes(genesis.genesis_validators_root))
+    store = ValidatorStore(cfg, SlashingProtection(MemoryDbController()), sks, p)
+    svc = DoppelgangerService(detection_epochs=1)
+    for sk in sks:
+        svc.register_validator(sk.to_pubkey(), current_epoch=2)  # non-genesis
+    v = Validator(chain=chain, store=store, p=p, doppelganger=svc)
+
+    out = asyncio.run(v.run_slot_duties(1))
+    assert out["proposed"] is None and out["attestations"] == []
+
+    # clear the window -> duties resume
+    for sk in sks:
+        svc.on_epoch_liveness(3, {sk.to_pubkey(): False})
+    out2 = asyncio.run(v.run_slot_duties(1))
+    assert out2["proposed"] is not None
+    assert out2["attestations"]
+
+
+def test_liveness_endpoint_over_http(minimal_preset):
+    from lodestar_tpu.api.impl import BeaconApiImpl
+    from lodestar_tpu.api.server import BeaconRestApiServer
+    from lodestar_tpu.chain.bls import BlsVerifierMock
+    from lodestar_tpu.chain.chain import BeaconChain
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
+
+    p = minimal_preset
+    genesis = create_interop_genesis_state(16, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis, bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(), current_slot=1,
+    )
+    chain.seen_attesters.add(2, 7)  # validator 7 was live in epoch 2
+    server = BeaconRestApiServer(BeaconApiImpl(chain), port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/eth/v1/validator/liveness/2",
+            method="POST",
+            data=json.dumps(["7", "8"]).encode(),
+        )
+        with urllib.request.urlopen(req) as r:
+            data = json.loads(r.read())["data"]
+        assert data == [
+            {"index": "7", "is_live": True},
+            {"index": "8", "is_live": False},
+        ]
+    finally:
+        server.stop()
