@@ -362,6 +362,7 @@ class BeaconApiImpl:
         def is_live(i: int) -> bool:
             return (
                 chain.seen_attesters.is_known(int(epoch), i)
+                or chain.seen_block_attesters.is_known(int(epoch), i)
                 or chain.seen_aggregators.is_known(int(epoch), i)
                 or chain.seen_block_proposers.is_known(int(epoch), i)
             )

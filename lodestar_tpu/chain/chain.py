@@ -145,6 +145,11 @@ class BeaconChain:
 
         self.seen_aggregators = SeenAggregators()
         self.seen_block_proposers = _EpochKeyedSet()
+        # block-INCLUDED attesters tracked separately from the gossip
+        # dedup cache (reference SeenBlockAttesters vs SeenAttesters):
+        # marking them "seen" for gossip would IGNORE late-arriving
+        # legitimate gossip attestations
+        self.seen_block_attesters = _EpochKeyedSet()
 
         # anchor: latest block header of the anchor state defines the root
         header = anchor_state.latest_block_header.copy()
@@ -393,7 +398,7 @@ class BeaconChain:
             except ValueError:
                 continue
             for i in attesting:
-                self.seen_attesters.add(int(att.data.target.epoch), int(i))
+                self.seen_block_attesters.add(int(att.data.target.epoch), int(i))
             self.fork_choice.on_attestation(
                 [int(i) for i in attesting],
                 _hex(bytes(att.data.beacon_block_root)),
