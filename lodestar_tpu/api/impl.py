@@ -272,6 +272,10 @@ class BeaconApiImpl:
             except GossipValidationError as e:
                 errors.append({"index": i, "message": str(e)})
                 continue
+            if not asyncio.run(self.chain.bls.verify_signature_sets(res.signature_sets)):
+                errors.append({"index": i, "message": "invalid attestation signature"})
+                continue
+            res.register_seen()
             root = self.t.AttestationData.hash_tree_root(att.data)
             self.chain.attestation_pool.add(att, root)
             self.chain.fork_choice.on_attestation(

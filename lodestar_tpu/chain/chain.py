@@ -438,6 +438,13 @@ class BeaconChain:
         keep = {bytes.fromhex(n.block_root[2:]) for n in self.fork_choice.proto_array.nodes}
         self.state_cache.prune_except(keep)
         self.regen.prune_on_finalized(cp.epoch)
+        for seen in (
+            self.seen_attesters,
+            self.seen_aggregators,
+            self.seen_block_attesters,
+            self.seen_block_proposers,
+        ):
+            seen.prune(cp.epoch)
         st = self.state_cache.get(root)
         if st is not None:
             self.op_pool.prune_all(st)

@@ -66,9 +66,15 @@ def _state_dialed_to(chain, block_root: bytes, slot: int):
 
 @dataclass
 class AttestationValidationResult:
+    """`register_seen` must be called only AFTER the signature sets
+    verify — registering earlier lets a bad-signature message censor the
+    real one and fake liveness (same contract as the sync-committee
+    results below)."""
+
     indexed_attestation: object
     attesting_indices: list[int]
     signature_sets: list[SignatureSet]
+    register_seen: object = lambda: None
 
 
 def validate_gossip_attestation(
@@ -120,11 +126,11 @@ def validate_gossip_attestation(
 
     indexed = get_indexed_attestation(attestation, ctx)
     sig_set = indexed_attestation_signature_set(state, indexed, ctx)
-    chain.seen_attesters.add(target_epoch, vi)
     return AttestationValidationResult(
         indexed_attestation=indexed,
         attesting_indices=attesting,
         signature_sets=[sig_set],
+        register_seen=lambda: chain.seen_attesters.add(target_epoch, vi),
     )
 
 
@@ -174,6 +180,9 @@ def validate_gossip_aggregate_and_proof(chain, signed_agg) -> AttestationValidat
     # [REJECT] selection proof selects the aggregator
     if not is_aggregator(len(committee), bytes(agg.selection_proof)):
         raise GossipValidationError(GossipAction.REJECT, "selection proof does not select")
+    # [IGNORE] first aggregate per (target epoch, aggregator)
+    if chain.seen_aggregators.is_known(int(data.target.epoch), int(agg.aggregator_index)):
+        raise GossipValidationError(GossipAction.IGNORE, "already seen aggregator")
 
     from lodestar_tpu import ssz
     from lodestar_tpu.state_transition.block import get_indexed_attestation
@@ -203,6 +212,9 @@ def validate_gossip_aggregate_and_proof(chain, signed_agg) -> AttestationValidat
         indexed_attestation=indexed,
         attesting_indices=[int(i) for i in indexed.attesting_indices],
         signature_sets=sets,
+        register_seen=lambda: chain.seen_aggregators.add(
+            int(data.target.epoch), int(agg.aggregator_index)
+        ),
     )
 
 

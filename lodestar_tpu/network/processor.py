@@ -1,0 +1,333 @@
+"""Gossip processor: bounded per-topic queues + chain backpressure.
+
+Reference `beacon-node/src/network/processor/` — `gossipQueues.ts`
+(per-topic maxLength/LIFO-vs-FIFO drop policies), `index.ts:316-330`
+(executeWork gated on `bls.canAcceptWork()` + `regen.canAcceptWork()`,
+MAX_JOBS_SUBMITTED_PER_TICK, blocks bypass the gate), and
+`gossipHandlers.ts` (validate → signature-verify → pools/fork-choice
+dispatch). This is the §2c "backpressure scheduling" seam: queue depth
+feeds back from device-pipeline occupancy.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from lodestar_tpu.logger import get_logger
+
+__all__ = ["NetworkProcessor", "GOSSIP_QUEUE_OPTS", "default_gossip_handlers"]
+
+MAX_JOBS_SUBMITTED_PER_TICK = 128
+
+# topic -> (max_length, "FIFO"|"LIFO")  (reference gossipQueues.ts:37-60)
+GOSSIP_QUEUE_OPTS: dict[str, tuple[int, str]] = {
+    "beacon_block": (1024, "FIFO"),
+    "beacon_aggregate_and_proof": (5120, "LIFO"),
+    "beacon_attestation": (24576, "LIFO"),
+    "voluntary_exit": (4096, "FIFO"),
+    "proposer_slashing": (4096, "FIFO"),
+    "attester_slashing": (4096, "FIFO"),
+    "sync_committee_contribution_and_proof": (4096, "LIFO"),
+    "sync_committee": (4096, "LIFO"),
+    "bls_to_execution_change": (4096, "FIFO"),
+}
+
+# blocks are processed immediately even under backpressure
+# (reference executeGossipWorkOrderObj bypassQueue)
+EXECUTE_ORDER = (
+    "beacon_block",
+    "beacon_aggregate_and_proof",
+    "beacon_attestation",
+    "sync_committee_contribution_and_proof",
+    "sync_committee",
+    "voluntary_exit",
+    "proposer_slashing",
+    "attester_slashing",
+    "bls_to_execution_change",
+)
+BYPASS_BACKPRESSURE = {"beacon_block"}
+
+
+@dataclass
+class PendingItem:
+    topic: str
+    message: object
+    peer: str
+    seen_at: float = field(default_factory=time.monotonic)
+
+
+class _TopicQueue:
+    def __init__(self, max_length: int, kind: str):
+        self.max_length = max_length
+        self.kind = kind
+        self._items: deque[PendingItem] = deque()
+        self.dropped = 0
+
+    def push(self, item: PendingItem) -> bool:
+        if len(self._items) >= self.max_length:
+            if self.kind == "LIFO":
+                self._items.popleft()  # drop oldest, keep freshest
+                self.dropped += 1
+            else:
+                self.dropped += 1
+                return False  # FIFO rejects new work
+        self._items.append(item)
+        return True
+
+    def pop(self) -> PendingItem | None:
+        if not self._items:
+            return None
+        return self._items.pop() if self.kind == "LIFO" else self._items.popleft()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class NetworkProcessor:
+    """Queue gossip messages per topic; drain them through injected
+    handlers when the chain can accept work."""
+
+    def __init__(self, chain, handlers: dict | None = None, metrics=None, report_peer=None):
+        self.chain = chain
+        self.handlers = handlers if handlers is not None else default_gossip_handlers(chain)
+        self.metrics = metrics
+        self.report_peer = report_peer  # (peer_id, reason) -> None; REJECTs downscore
+        self.log = get_logger(name="lodestar.processor")
+        self.queues = {
+            topic: _TopicQueue(max_len, kind)
+            for topic, (max_len, kind) in GOSSIP_QUEUE_OPTS.items()
+        }
+        self.processed = 0
+        self.errors = 0
+
+    # -- ingress ---------------------------------------------------------------
+
+    def push(self, topic: str, message, peer: str = "") -> bool:
+        q = self.queues.get(topic)
+        if q is None:
+            return False
+        return q.push(PendingItem(topic, message, peer))
+
+    # -- backpressure ----------------------------------------------------------
+
+    def _cannot_accept_reason(self) -> str | None:
+        bls = getattr(self.chain, "bls", None)
+        if bls is not None and not bls.can_accept_work():
+            return "bls_busy"
+        regen = getattr(self.chain, "regen", None)
+        if regen is not None and not regen.can_accept_work():
+            return "regen_busy"
+        return None
+
+    @property
+    def pending(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    # -- drain -----------------------------------------------------------------
+
+    async def execute_work(self, max_jobs: int = MAX_JOBS_SUBMITTED_PER_TICK) -> int:
+        """One drain tick: submit up to max_jobs items in the reference's
+        priority order; non-block topics stop when the chain is
+        backpressured. Returns jobs executed."""
+        submitted = 0
+        while submitted < max_jobs:
+            reason = self._cannot_accept_reason()
+            progressed = False
+            for topic in EXECUTE_ORDER:
+                if reason is not None and topic not in BYPASS_BACKPRESSURE:
+                    continue
+                item = self.queues[topic].pop()
+                if item is None:
+                    continue
+                handler = self.handlers.get(topic)
+                if handler is None:
+                    continue
+                try:
+                    await handler(item.message, item.peer)
+                    self.processed += 1
+                except Exception as e:
+                    self.errors += 1
+                    self.log.debug(
+                        "gossip handler error", {"topic": topic, "error": str(e)[:120]}
+                    )
+                    # REJECT-class failures downscore the sender
+                    # (reference gossipHandlers -> peerManager scoring)
+                    if self.report_peer is not None and item.peer:
+                        from lodestar_tpu.chain.validation import GossipAction
+
+                        if getattr(e, "action", None) is GossipAction.REJECT:
+                            self.report_peer(item.peer, f"{topic}: {e}")
+                submitted += 1
+                progressed = True
+                break  # re-evaluate backpressure + priorities each job
+            if not progressed:
+                break
+        return submitted
+
+
+def default_gossip_handlers(chain) -> dict:
+    """validate → verify signature sets → pools/fork-choice dispatch
+    (reference gossipHandlers.ts:245-281). Handlers raise on REJECT so
+    the caller can downscore; IGNOREs return silently."""
+    from lodestar_tpu.chain.validation import (
+        GossipAction,
+        GossipValidationError,
+        validate_gossip_aggregate_and_proof,
+        validate_gossip_attestation,
+        validate_gossip_block,
+        validate_sync_committee_contribution,
+        validate_sync_committee_message,
+    )
+
+    async def _verify(sets) -> bool:
+        return await chain.bls.verify_signature_sets(sets)
+
+    async def on_block(message, peer):
+        try:
+            validate_gossip_block(chain, message)
+        except GossipValidationError as e:
+            if e.action is GossipAction.REJECT:
+                raise
+            return  # duplicates / future / parent-unknown are benign
+        await chain.process_block(message, is_timely=True)
+
+    async def on_attestation(message, peer):
+        try:
+            res = validate_gossip_attestation(chain, message)
+        except GossipValidationError as e:
+            if e.action is GossipAction.REJECT:
+                raise
+            return
+        if not await _verify(res.signature_sets):
+            raise GossipValidationError(GossipAction.REJECT, "bad attestation signature")
+        res.register_seen()
+        t = chain.types
+        root = t.AttestationData.hash_tree_root(message.data)
+        chain.attestation_pool.add(message, root)
+        chain.fork_choice.on_attestation(
+            res.attesting_indices,
+            "0x" + bytes(message.data.beacon_block_root).hex(),
+            message.data.target.epoch,
+            message.data.slot,
+        )
+
+    async def on_aggregate(message, peer):
+        try:
+            res = validate_gossip_aggregate_and_proof(chain, message)
+        except GossipValidationError as e:
+            if e.action is GossipAction.REJECT:
+                raise
+            return
+        if not await _verify(res.signature_sets):
+            raise GossipValidationError(GossipAction.REJECT, "bad aggregate signatures")
+        res.register_seen()
+        agg = message.message.aggregate
+        t = chain.types
+        root = t.AttestationData.hash_tree_root(agg.data)
+        chain.aggregated_attestation_pool.add(agg, root)
+        chain.fork_choice.on_attestation(
+            res.attesting_indices,
+            "0x" + bytes(agg.data.beacon_block_root).hex(),
+            agg.data.target.epoch,
+            agg.data.slot,
+        )
+
+    async def on_sync_message(item, peer):
+        # item = (subnet, message) — the subnet rides with the topic
+        subnet, message = item
+        try:
+            res = validate_sync_committee_message(chain, message, subnet)
+        except GossipValidationError as e:
+            if e.action is GossipAction.REJECT:
+                raise
+            return
+        if not await _verify(res.signature_sets):
+            raise GossipValidationError(GossipAction.REJECT, "bad sync message signature")
+        res.register_seen()
+        for pos in res.indices_in_subcommittee:
+            chain.sync_committee_message_pool.add(subnet, message, pos)
+
+    async def on_sync_contribution(message, peer):
+        try:
+            res = validate_sync_committee_contribution(chain, message)
+        except GossipValidationError as e:
+            if e.action is GossipAction.REJECT:
+                raise
+            return
+        if not await _verify(res.signature_sets):
+            raise GossipValidationError(GossipAction.REJECT, "bad contribution signatures")
+        res.register_seen()
+        chain.sync_contribution_pool.add(message.message)
+
+    # op-pool topics run the SPEC processing (incl. signatures) on a
+    # throwaway head-state clone before pooling — a garbage-signature
+    # exit/slashing must never enter the pool where block production
+    # would package it (reference validation/voluntaryExit.ts etc. route
+    # these through the state transition checks)
+
+    def _validation_state():
+        return chain.get_head_state().copy()
+
+    async def on_voluntary_exit(message, peer):
+        from lodestar_tpu.state_transition import BlockProcessError, EpochContext
+        from lodestar_tpu.state_transition.block import process_voluntary_exit
+
+        if chain.op_pool.has_exit(int(message.message.validator_index)):
+            return  # [IGNORE] already known
+        state = _validation_state()
+        try:
+            process_voluntary_exit(state, message, EpochContext(state, chain.p), True, chain.cfg)
+        except BlockProcessError as e:
+            raise GossipValidationError(GossipAction.REJECT, f"invalid exit: {e}") from e
+        chain.op_pool.insert_voluntary_exit(message)
+
+    async def on_proposer_slashing(message, peer):
+        from lodestar_tpu.state_transition import BlockProcessError, EpochContext
+        from lodestar_tpu.state_transition.block import process_proposer_slashing
+
+        state = _validation_state()
+        try:
+            process_proposer_slashing(state, message, EpochContext(state, chain.p), True, chain.cfg)
+        except BlockProcessError as e:
+            raise GossipValidationError(GossipAction.REJECT, f"invalid proposer slashing: {e}") from e
+        chain.op_pool.insert_proposer_slashing(message)
+
+    async def on_attester_slashing(message, peer):
+        from lodestar_tpu.state_transition import BlockProcessError, EpochContext
+        from lodestar_tpu.state_transition.block import process_attester_slashing
+
+        state = _validation_state()
+        try:
+            process_attester_slashing(state, message, EpochContext(state, chain.p), True, chain.cfg)
+        except BlockProcessError as e:
+            raise GossipValidationError(GossipAction.REJECT, f"invalid attester slashing: {e}") from e
+        t = chain.types
+        root = t.AttesterSlashing.hash_tree_root(message)
+        chain.op_pool.insert_attester_slashing(message, root)
+
+    async def on_bls_change(message, peer):
+        from lodestar_tpu.state_transition import BlockProcessError, EpochContext
+        from lodestar_tpu.state_transition.capella import process_bls_to_execution_change
+
+        state = _validation_state()
+        try:
+            process_bls_to_execution_change(
+                state, message, EpochContext(state, chain.p), True, chain.cfg
+            )
+        except BlockProcessError as e:
+            raise GossipValidationError(GossipAction.REJECT, f"invalid bls change: {e}") from e
+        chain.op_pool.insert_bls_to_execution_change(message)
+
+    return {
+        "beacon_block": on_block,
+        "beacon_attestation": on_attestation,
+        "beacon_aggregate_and_proof": on_aggregate,
+        "sync_committee": on_sync_message,
+        "sync_committee_contribution_and_proof": on_sync_contribution,
+        "voluntary_exit": on_voluntary_exit,
+        "proposer_slashing": on_proposer_slashing,
+        "attester_slashing": on_attester_slashing,
+        "bls_to_execution_change": on_bls_change,
+    }

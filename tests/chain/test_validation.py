@@ -102,7 +102,12 @@ def test_attestation_accepts_and_yields_verifiable_set(env):
 def test_attestation_first_seen_dedup(env):
     p, sks, genesis, chain, blocks = env
     att = _gossip_att(env, vi_bit=1)
+    res = validate_gossip_attestation(chain, att)
+    # seen-cache registration is deferred until the signature verifies —
+    # before that, a duplicate is NOT ignored (a bad-signature message
+    # must not censor the real one)
     validate_gossip_attestation(chain, att)
+    res.register_seen()
     with pytest.raises(GossipValidationError) as ei:
         validate_gossip_attestation(chain, att)
     assert ei.value.action is GossipAction.IGNORE

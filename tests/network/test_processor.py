@@ -1,0 +1,137 @@
+"""NetworkProcessor: queue drop policies, backpressure gating (blocks
+bypass), and validate→verify→pool dispatch through the default
+handlers."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.network.processor import NetworkProcessor, _TopicQueue, PendingItem
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.types import ssz_types
+
+from ..state_transition.test_state_transition import _empty_block_at
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def test_queue_policies():
+    lifo = _TopicQueue(2, "LIFO")
+    for i in range(3):
+        assert lifo.push(PendingItem("t", i, ""))
+    assert lifo.dropped == 1  # oldest (0) dropped
+    assert lifo.pop().message == 2  # freshest first
+    assert lifo.pop().message == 1
+
+    fifo = _TopicQueue(2, "FIFO")
+    assert fifo.push(PendingItem("t", 0, ""))
+    assert fifo.push(PendingItem("t", 1, ""))
+    assert not fifo.push(PendingItem("t", 2, ""))  # reject new
+    assert fifo.pop().message == 0  # oldest first
+
+
+def _chain(genesis, slot=2):
+    return BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=slot,
+    )
+
+
+def test_backpressure_gates_all_but_blocks(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = _chain(genesis)
+
+    async def go():
+        calls = []
+
+        async def h_block(m, peer):
+            calls.append("block")
+
+        async def h_att(m, peer):
+            calls.append("att")
+
+        proc = NetworkProcessor(
+            chain, handlers={"beacon_block": h_block, "beacon_attestation": h_att}
+        )
+        proc.push("beacon_block", object())
+        proc.push("beacon_attestation", object())
+
+        # simulate a saturated device verifier
+        chain.bls.can_accept_work = lambda: False
+        n = await proc.execute_work()
+        assert n == 1 and calls == ["block"]  # only the block bypassed
+
+        chain.bls.can_accept_work = lambda: True
+        n2 = await proc.execute_work()
+        assert n2 == 1 and calls == ["block", "att"]
+
+    asyncio.run(go())
+
+
+def test_default_handlers_end_to_end(minimal_preset):
+    """Block + single attestation via gossip dispatch: validated, pooled,
+    and counted in the fork-choice votes."""
+    from lodestar_tpu.crypto.bls import api as bls_api
+    from lodestar_tpu.state_transition import EpochContext, compute_signing_root, get_domain
+    from lodestar_tpu.state_transition.util import get_block_root_at_slot
+
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = _chain(genesis)
+    t = ssz_types(p)
+    proc = NetworkProcessor(chain)
+
+    signed = _empty_block_at(genesis, 1, sks, p)
+    assert proc.push("beacon_block", signed)
+
+    async def go():
+        n = await proc.execute_work()
+        assert n == 1 and proc.errors == 0
+        assert chain.get_head_state().slot == 1
+
+        # craft a valid single attestation for slot 1 on the new head
+        state = chain.get_head_state()
+        ctx = EpochContext(state, p)
+        committee = ctx.get_beacon_committee(1, 0)
+        from lodestar_tpu.chain.produce_block import make_attestation_data
+
+        data = make_attestation_data(chain, 1, 0)
+        att = t.Attestation.default()
+        bits = [False] * len(committee)
+        bits[0] = True
+        att.aggregation_bits = bits
+        att.data = data
+        vi = int(committee[0])
+        from lodestar_tpu.params import DOMAIN_BEACON_ATTESTER
+
+        domain = get_domain(state, DOMAIN_BEACON_ATTESTER, data.target.epoch)
+        att.signature = bls_api.sign(
+            sks[vi], compute_signing_root(t.AttestationData, data, domain)
+        )
+        assert proc.push("beacon_attestation", att)
+        n2 = await proc.execute_work()
+        assert n2 == 1 and proc.errors == 0, f"errors={proc.errors}"
+        # pooled for aggregation
+        root = t.AttestationData.hash_tree_root(data)
+        assert chain.attestation_pool.get_aggregate(1, root) is not None
+
+    asyncio.run(go())
