@@ -264,26 +264,24 @@ class BeaconApiImpl:
     def submit_pool_attestations(self, body: list) -> dict:
         from lodestar_tpu.chain.validation import GossipValidationError, validate_gossip_attestation
 
+        from lodestar_tpu.network.processor import import_verified_attestation
+
         errors = []
-        for i, att_json in enumerate(body):
-            att = from_json(self.t.Attestation, att_json)
-            try:
-                res = validate_gossip_attestation(self.chain, att)
-            except GossipValidationError as e:
-                errors.append({"index": i, "message": str(e)})
-                continue
-            if not asyncio.run(self.chain.bls.verify_signature_sets(res.signature_sets)):
-                errors.append({"index": i, "message": "invalid attestation signature"})
-                continue
-            res.register_seen()
-            root = self.t.AttestationData.hash_tree_root(att.data)
-            self.chain.attestation_pool.add(att, root)
-            self.chain.fork_choice.on_attestation(
-                res.attesting_indices,
-                "0x" + bytes(att.data.beacon_block_root).hex(),
-                att.data.target.epoch,
-                att.data.slot,
-            )
+
+        async def run_batch():
+            for i, att_json in enumerate(body):
+                att = from_json(self.t.Attestation, att_json)
+                try:
+                    res = validate_gossip_attestation(self.chain, att)
+                except GossipValidationError as e:
+                    errors.append({"index": i, "message": str(e)})
+                    continue
+                if not await self.chain.bls.verify_signature_sets(res.signature_sets):
+                    errors.append({"index": i, "message": "invalid attestation signature"})
+                    continue
+                import_verified_attestation(self.chain, res, att)
+
+        asyncio.run(run_batch())
         if errors:
             raise ApiError(400, f"some attestations failed: {errors}")
         return {}

@@ -138,11 +138,11 @@ class NetworkProcessor:
             for topic in EXECUTE_ORDER:
                 if reason is not None and topic not in BYPASS_BACKPRESSURE:
                     continue
-                item = self.queues[topic].pop()
-                if item is None:
-                    continue
                 handler = self.handlers.get(topic)
                 if handler is None:
+                    continue  # topic not handled: leave items queued (and countable)
+                item = self.queues[topic].pop()
+                if item is None:
                     continue
                 try:
                     await handler(item.message, item.peer)
@@ -165,6 +165,27 @@ class NetworkProcessor:
             if not progressed:
                 break
         return submitted
+
+
+def import_verified_attestation(chain, res, attestation, aggregated: bool = False) -> None:
+    """Post-verification attestation import: register the seen cache,
+    pool (naive or aggregated), feed fork-choice votes. The ONE place the
+    register-after-verify ordering contract lives — the gossip processor
+    and the REST pool endpoint both call it."""
+    res.register_seen()
+    t = chain.types
+    data = attestation.data
+    root = t.AttestationData.hash_tree_root(data)
+    if aggregated:
+        chain.aggregated_attestation_pool.add(attestation, root)
+    else:
+        chain.attestation_pool.add(attestation, root)
+    chain.fork_choice.on_attestation(
+        res.attesting_indices,
+        "0x" + bytes(data.beacon_block_root).hex(),
+        data.target.epoch,
+        data.slot,
+    )
 
 
 def default_gossip_handlers(chain) -> dict:
@@ -202,16 +223,7 @@ def default_gossip_handlers(chain) -> dict:
             return
         if not await _verify(res.signature_sets):
             raise GossipValidationError(GossipAction.REJECT, "bad attestation signature")
-        res.register_seen()
-        t = chain.types
-        root = t.AttestationData.hash_tree_root(message.data)
-        chain.attestation_pool.add(message, root)
-        chain.fork_choice.on_attestation(
-            res.attesting_indices,
-            "0x" + bytes(message.data.beacon_block_root).hex(),
-            message.data.target.epoch,
-            message.data.slot,
-        )
+        import_verified_attestation(chain, res, message)
 
     async def on_aggregate(message, peer):
         try:
@@ -222,17 +234,7 @@ def default_gossip_handlers(chain) -> dict:
             return
         if not await _verify(res.signature_sets):
             raise GossipValidationError(GossipAction.REJECT, "bad aggregate signatures")
-        res.register_seen()
-        agg = message.message.aggregate
-        t = chain.types
-        root = t.AttestationData.hash_tree_root(agg.data)
-        chain.aggregated_attestation_pool.add(agg, root)
-        chain.fork_choice.on_attestation(
-            res.attesting_indices,
-            "0x" + bytes(agg.data.beacon_block_root).hex(),
-            agg.data.target.epoch,
-            agg.data.slot,
-        )
+        import_verified_attestation(chain, res, message.message.aggregate, aggregated=True)
 
     async def on_sync_message(item, peer):
         # item = (subnet, message) — the subnet rides with the topic

@@ -44,7 +44,9 @@ class BeaconNodeOptions:
 
 
 class BeaconNode:
-    def __init__(self, *, chain, clock, db, metrics, rest_server, metrics_server, bls):
+    def __init__(
+        self, *, chain, clock, db, metrics, rest_server, metrics_server, bls, processor=None
+    ):
         self.chain = chain
         self.clock = clock
         self.db = db
@@ -52,7 +54,33 @@ class BeaconNode:
         self.rest_server = rest_server
         self.metrics_server = metrics_server
         self.bls = bls
+        self.processor = processor
+        self._drain_task = None
         self.log = get_logger(name="lodestar.node")
+
+    def on_gossip(self, topic: str, message, peer: str = "") -> bool:
+        """Ingress point for the network layer: enqueue a gossip message
+        for validated processing (reference network -> NetworkProcessor)."""
+        return self.processor.push(topic, message, peer) if self.processor else False
+
+    def start_gossip_drain(self, interval_s: float = 0.05) -> None:
+        """Background drain loop over the processor's queues (reference
+        NetworkProcessor executeWork scheduling)."""
+        import asyncio
+
+        if self.processor is None or self._drain_task is not None:
+            return
+
+        async def loop():
+            while True:
+                try:
+                    n = await self.processor.execute_work()
+                except Exception as e:  # keep draining through handler storms
+                    self.log.warn("gossip drain error", {"error": str(e)[:120]})
+                    n = 0
+                await asyncio.sleep(0 if n else interval_s)
+
+        self._drain_task = asyncio.ensure_future(loop())
 
     @classmethod
     async def init(
@@ -114,7 +142,12 @@ class BeaconNode:
         if not opts.manual_clock:
             clock.start()
 
-        # 6. REST API
+        # 6. gossip processor (network ingress -> validated dispatch)
+        from lodestar_tpu.network.processor import NetworkProcessor
+
+        processor = NetworkProcessor(chain)
+
+        # 7. REST API
         rest_server = None
         if opts.rest_enabled:
             rest_server = BeaconRestApiServer(BeaconApiImpl(chain), port=opts.rest_port)
@@ -123,7 +156,10 @@ class BeaconNode:
         node = cls(
             chain=chain, clock=clock, db=db, metrics=metrics,
             rest_server=rest_server, metrics_server=metrics_server, bls=bls,
+            processor=processor,
         )
+        if not opts.manual_clock:
+            node.start_gossip_drain()
         node.log.info(
             f"beacon node up: slot {clock.current_slot}, "
             f"rest {'on :' + str(rest_server.port) if rest_server else 'off'}"
@@ -132,6 +168,13 @@ class BeaconNode:
 
     async def close(self) -> None:
         """Abort cascade, reverse init order (nodejs.ts:146-152)."""
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+            try:
+                await self._drain_task  # let a mid-import handler finish/unwind
+            except BaseException:
+                pass
+            self._drain_task = None
         if self.rest_server is not None:
             self.rest_server.stop()
         await self.clock.stop()

@@ -129,3 +129,33 @@ def test_checkpoint_sync_wss_and_malformed_gates(minimal_preset):
     # the wss gate is opt-out: omitting current_slot without allow_stale fails
     with pytest.raises(CheckpointSyncError, match="current_slot is required"):
         fetch_checkpoint_state(impl, p=p)
+
+
+def test_node_gossip_ingress_and_drain(minimal_preset):
+    """BeaconNode.on_gossip -> processor queue -> background drain loop
+    imports the block (the network ingress seam)."""
+    import asyncio as _asyncio
+
+    from lodestar_tpu.node import BeaconNode, BeaconNodeOptions
+
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+
+    async def go():
+        node = await BeaconNode.init(
+            anchor_state=genesis,
+            opts=BeaconNodeOptions(rest_enabled=False, manual_clock=True),
+            p=p,
+        )
+        signed = _empty_block_at(genesis, 1, sks, p)
+        assert node.on_gossip("beacon_block", signed, peer="p1")
+        node.start_gossip_drain(interval_s=0.01)
+        for _ in range(100):
+            if node.processor.processed:
+                break
+            await _asyncio.sleep(0.02)
+        assert node.chain.get_head_state().slot == 1
+        await node.close()
+
+    _asyncio.run(go())
