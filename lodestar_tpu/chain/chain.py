@@ -18,6 +18,7 @@ Reference `beacon-node/src/chain/chain.ts:88` + `chain/blocks/`:
 
 from __future__ import annotations
 
+import threading
 from typing import Callable
 
 from lodestar_tpu.db import Bucket, DbController, Repository
@@ -112,6 +113,11 @@ class BeaconChain:
         self.states_db: Repository = Repository(db, Bucket.allForks_stateArchive, anchor_state.type)
 
         self.state_cache = StateCache()
+        # serializes chain mutations across threads: the asyncio gossip
+        # drain (event-loop thread) and the threaded REST server both
+        # import blocks/attestations — the structures below have no
+        # internal locking (the reference is single-threaded Node.js)
+        self.import_lock = threading.RLock()
         from .archiver import DEFAULT_ARCHIVE_STATE_EPOCH_FREQUENCY, Archiver
         from .regen import QueuedStateRegenerator
 
@@ -292,7 +298,13 @@ class BeaconChain:
     # -- block import ---------------------------------------------------------
 
     async def process_block(self, signed_block, *, is_timely: bool = False):
-        """Full import pipeline for one gossip/sync block."""
+        """Full import pipeline for one gossip/sync block. Serialized
+        with other chain mutations via import_lock (REST threads vs the
+        gossip drain loop)."""
+        with self.import_lock:
+            return await self._process_block_locked(signed_block, is_timely=is_timely)
+
+    async def _process_block_locked(self, signed_block, *, is_timely: bool = False):
         t = self.types
         block = signed_block.message
         block_type, signed_type = self.block_type_at_slot(block.slot)

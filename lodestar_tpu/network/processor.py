@@ -171,7 +171,14 @@ def import_verified_attestation(chain, res, attestation, aggregated: bool = Fals
     """Post-verification attestation import: register the seen cache,
     pool (naive or aggregated), feed fork-choice votes. The ONE place the
     register-after-verify ordering contract lives — the gossip processor
-    and the REST pool endpoint both call it."""
+    and the REST pool endpoint both call it. Holds the chain's import
+    lock: REST handler threads and the gossip drain loop would otherwise
+    interleave mid-structure."""
+    with chain.import_lock:
+        _import_verified_attestation_locked(chain, res, attestation, aggregated)
+
+
+def _import_verified_attestation_locked(chain, res, attestation, aggregated: bool) -> None:
     res.register_seen()
     t = chain.types
     data = attestation.data

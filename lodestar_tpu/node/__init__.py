@@ -66,8 +66,6 @@ class BeaconNode:
     def start_gossip_drain(self, interval_s: float = 0.05) -> None:
         """Background drain loop over the processor's queues (reference
         NetworkProcessor executeWork scheduling)."""
-        import asyncio
-
         if self.processor is None or self._drain_task is not None:
             return
 

@@ -1,0 +1,121 @@
+"""REST-mode validator: duties driven over the Beacon API.
+
+Reference `packages/validator/src/validator.ts` + `services/` — the
+production deployment shape: a separate validator process talking to the
+beacon node purely through the standard REST endpoints (duties →
+produce → sign → publish). The in-process `Validator` (this package's
+__init__) is the dev/test shape; this client is the cross-process one.
+All signing still flows through ValidatorStore (slashing-protected) and
+the optional doppelganger gate.
+"""
+
+from __future__ import annotations
+
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.params import BeaconPreset, active_preset
+from lodestar_tpu.ssz.json import from_json, to_json
+from lodestar_tpu.types import ssz_types
+
+from .store import ValidatorStore
+
+__all__ = ["RestValidator"]
+
+
+class RestValidator:
+    """Per-slot duty runner over a BeaconApiClient-compatible client
+    (any object with get_proposer_duties / get_attester_duties /
+    produce_block_v2 / produce_attestation_data / publish_block /
+    submit_pool_attestations)."""
+
+    def __init__(
+        self,
+        *,
+        client,
+        store: ValidatorStore,
+        p: BeaconPreset | None = None,
+        doppelganger=None,
+    ):
+        self.client = client
+        self.store = store
+        self.p = p or active_preset()
+        self.doppelganger = doppelganger
+        self.log = get_logger(name="lodestar.validator.rest")
+        # validator index -> pubkey for OUR keys, filled lazily from the API
+        self._index_to_pubkey: dict[int, bytes] = {}
+
+    def _may_sign(self, pubkey: bytes) -> bool:
+        if not self.store.has_pubkey(pubkey):
+            return False
+        return self.doppelganger is None or self.doppelganger.is_safe(pubkey)
+
+    def refresh_indices(self) -> None:
+        """Map our pubkeys to validator indices via the state validators
+        endpoint (reference indicesService.pollValidatorIndices)."""
+        res = self.client.get_state_validators("head")
+        ours = set(self.store.pubkeys)
+        for entry in res.get("data", []):
+            pk = bytes.fromhex(entry["validator"]["pubkey"][2:])
+            if pk in ours:
+                self._index_to_pubkey[int(entry["index"])] = pk
+
+    def run_slot_duties(self, slot: int) -> dict:
+        """Propose (if selected) then attest for `slot`. Synchronous —
+        the REST calls are blocking; callers schedule per slot."""
+        if not self._index_to_pubkey:
+            self.refresh_indices()
+        out = {"proposed": None, "attestations": []}
+        epoch = slot // self.p.SLOTS_PER_EPOCH
+        t = ssz_types(self.p)
+
+        # -- proposal (services/block.ts over the API) --
+        duties = self.client.get_proposer_duties(epoch).get("data", [])
+        my_duty = next(
+            (
+                d
+                for d in duties
+                if int(d["slot"]) == slot and int(d["validator_index"]) in self._index_to_pubkey
+            ),
+            None,
+        )
+        if my_duty is not None:
+            pk = self._index_to_pubkey[int(my_duty["validator_index"])]
+            if self._may_sign(pk):
+                reveal = self.store.sign_randao(pk, epoch)
+                res = self.client.produce_block_v2(slot, reveal)
+                fork = res.get("version", "phase0")
+                block = from_json(getattr(t, fork).BeaconBlock, res["data"])
+                signed = self.store.sign_block(pk, block)
+                signed_type = getattr(t, fork).SignedBeaconBlock
+                self.client.publish_block(to_json(signed_type, signed))
+                out["proposed"] = signed
+
+        # -- attestations (services/attestation.ts over the API) --
+        att_duties = self.client.get_attester_duties(
+            epoch, sorted(self._index_to_pubkey)
+        ).get("data", [])
+        to_submit = []
+        for duty in att_duties:
+            if int(duty["slot"]) != slot:
+                continue
+            vi = int(duty["validator_index"])
+            pk = self._index_to_pubkey.get(vi)
+            if pk is None or not self._may_sign(pk):
+                continue
+            data_json = self.client.produce_attestation_data(
+                slot, int(duty["committee_index"])
+            )["data"]
+            data = from_json(t.AttestationData, data_json)
+            sig = self.store.sign_attestation(pk, data)
+            att = t.Attestation.default()
+            bits = [False] * int(duty["committee_length"])
+            bits[int(duty["validator_committee_index"])] = True
+            att.aggregation_bits = bits
+            att.data = data
+            att.signature = sig
+            to_submit.append(att)
+        if to_submit:
+            self.client.submit_pool_attestations(
+                [to_json(t.Attestation, a) for a in to_submit]
+            )
+        out["attestations"] = to_submit
+        return out
