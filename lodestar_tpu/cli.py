@@ -105,9 +105,33 @@ async def _run_beacon(args) -> int:
     from lodestar_tpu.node import BeaconNode, BeaconNodeOptions
     from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
 
+    from lodestar_tpu.config import mainnet_chain_config, minimal_chain_config
+
     params.set_active_preset(args.preset)
     p = params.active_preset()
-    if args.checkpoint_sync_url:
+    chain_cfg = minimal_chain_config() if args.preset == "minimal" else mainnet_chain_config()
+    anchor = None
+    db = None
+    if args.db:
+        from lodestar_tpu.db import FileDbController
+        from lodestar_tpu.node.checkpoint_sync import load_anchor_state_from_db
+
+        db = FileDbController(args.db + "/wal.log")
+        try:
+            anchor = load_anchor_state_from_db(db, p, chain_cfg)
+        except (OSError, ValueError) as e:
+            # a NON-EMPTY datadir that cannot be decoded must abort, not
+            # silently start a fresh chain into the same wal (wrong
+            # --preset / corruption would interleave two chains)
+            print(
+                f"error: data directory {args.db} exists but its archived state "
+                f"cannot be decoded under preset {args.preset!r}: {e}",
+                file=sys.stderr,
+            )
+            return 1
+    if anchor is not None:
+        pass  # resumed from the data directory
+    elif args.checkpoint_sync_url:
         import time as _time
 
         from lodestar_tpu.api.client import BeaconApiClient
@@ -122,6 +146,7 @@ async def _run_beacon(args) -> int:
         anchor = create_interop_genesis_state(args.genesis_validators, p=p)
     node = await BeaconNode.init(
         anchor_state=anchor,
+        chain_config=chain_cfg,
         opts=BeaconNodeOptions(
             db_path=(args.db + "/wal.log") if args.db else None,
             rest_port=args.rest_port,
@@ -129,6 +154,7 @@ async def _run_beacon(args) -> int:
             metrics_port=args.metrics_port,
         ),
         p=p,
+        db=db,
     )
     print(f"beacon node running; REST on :{node.rest_server.port}  (ctrl-c to stop)")
     try:

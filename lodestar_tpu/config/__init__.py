@@ -97,6 +97,16 @@ class ForkInfo:
     prev_fork_name: str
 
 
+def fork_name_at_epoch(cfg: ChainConfig, epoch: int) -> str:
+    """Active fork name at an epoch for a plain ChainConfig (shared by
+    the chain runtime and restart/checkpoint loaders)."""
+    name = "phase0"
+    for fork in ("altair", "bellatrix", "capella", "deneb"):
+        if cfg.fork_epoch(fork) <= epoch:
+            name = fork
+    return name
+
+
 def _fork_schedule(cfg: ChainConfig) -> tuple[ForkInfo, ...]:
     out = []
     prev_version = cfg.GENESIS_FORK_VERSION

@@ -89,16 +89,18 @@ class BeaconNode:
         opts: BeaconNodeOptions | None = None,
         p: BeaconPreset | None = None,
         time_fn=None,
+        db: DbController | None = None,
     ) -> "BeaconNode":
         opts = opts or BeaconNodeOptions()
         p = p or active_preset()
 
-        # 1. db
-        db: DbController
-        if opts.db_path:
-            db = FileDbController(opts.db_path)
-        else:
-            db = MemoryDbController()
+        # 1. db (a pre-opened controller — e.g. from the restart-from-db
+        # anchor probe — takes precedence; the WAL replays only once)
+        if db is None:
+            if opts.db_path:
+                db = FileDbController(opts.db_path)
+            else:
+                db = MemoryDbController()
 
         # 2. metrics
         metrics: BeaconMetrics = create_metrics()

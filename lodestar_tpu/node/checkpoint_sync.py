@@ -80,3 +80,34 @@ def fetch_checkpoint_state(
         {"fork": fork, "slot": int(state.slot), "validators": len(state.validators)},
     )
     return state
+
+
+def load_anchor_state_from_db(db, p: BeaconPreset | None = None, cfg=None):
+    """Restart-from-db: the newest archived finalized state in the data
+    directory, fork-decoded, or None for a fresh datadir (reference
+    `initBeaconState.ts` db branch — mechanism (3) of SURVEY §5
+    checkpoint/resume; the archiver wrote these at finalization)."""
+    from lodestar_tpu.db import Bucket, Repository
+    from lodestar_tpu.ssz import uint64
+
+    p = p or active_preset()
+    repo = Repository(db, Bucket.allForks_stateArchive, uint64)  # keys only
+    keys = repo.keys()
+    if not keys:
+        return None
+    slot = int.from_bytes(keys[-1], "big")
+    raw = repo.get_binary(slot)
+    if raw is None:
+        return None
+    epoch = slot // p.SLOTS_PER_EPOCH
+    fork = "phase0"
+    if cfg is not None:
+        from lodestar_tpu.config import fork_name_at_epoch
+
+        fork = fork_name_at_epoch(cfg, epoch)
+    t = ssz_types(p)
+    state = getattr(t, fork).BeaconState.deserialize(raw)
+    get_logger(name="lodestar.checkpoint_sync").info(
+        "resuming from archived state", {"slot": slot, "fork": fork}
+    )
+    return state
