@@ -57,10 +57,18 @@ class StatesArchiver:
         state = self.chain.state_cache.get(root)
         if state is None:
             return
+        from lodestar_tpu.state_transition.block import fork_of
+
         slot = int(state.slot)
         # serialize with the state's own (fork-versioned) type, not the
-        # repository's anchor type
+        # repository's anchor type; record the fork name so restart can
+        # decode WITHOUT guessing from the config (a state's actual fork
+        # can lag the schedule, e.g. genesis-epoch activations)
         self.chain.states_db.put_binary(slot, state.type.serialize(state))
+        self.db.put(
+            encode_key(Bucket.index_chainInfo, f"state_fork_{slot:020d}"),
+            fork_of(state).encode(),
+        )
         state_root = state.type.hash_tree_root(state)
         self.db.put(
             encode_key(Bucket.index_stateArchiveRootIndex, state_root),
@@ -163,7 +171,8 @@ class Archiver:
 
     def _decode_state(self, slot: int, raw: bytes):
         chain = self.chain
-        fork = chain.fork_name_at_slot(slot)
+        recorded = self.db.get(encode_key(Bucket.index_chainInfo, f"state_fork_{slot:020d}"))
+        fork = recorded.decode() if recorded else chain.fork_name_at_slot(slot)
         state_type = getattr(chain.types, fork).BeaconState
         return state_type.deserialize(raw)
 

@@ -230,10 +230,13 @@ class BlsDeviceVerifierPool(IBlsVerifier):
 
         # RLC-batch the batchable jobs in ≥16-set chunks; invalid batch →
         # retry each job individually (worker.ts:52-96)
+        from lodestar_tpu.utils.tracing import trace_region
+
         for chunk in chunkify_maximize_chunk_size(batchable, BATCHABLE_MIN_PER_CHUNK):
             all_sets = [s for j in chunk for s in j.sets]
             try:
-                ok = self._verify_fn(all_sets)
+                with trace_region("bls_batch_verify"):
+                    ok = self._verify_fn(all_sets)
             except Exception:
                 self.metrics["batch_retries"] += 1
                 individual.extend(chunk)

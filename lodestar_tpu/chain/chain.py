@@ -199,15 +199,11 @@ class BeaconChain:
     # -- fork-aware types ------------------------------------------------------
 
     def fork_name_at_slot(self, slot: int) -> str:
-        cfg = self.cfg
-        if cfg is None:
+        if self.cfg is None:
             return "phase0"
-        epoch = slot // self.p.SLOTS_PER_EPOCH
-        name = "phase0"
-        for fork in ("altair", "bellatrix", "capella", "deneb"):
-            if getattr(cfg, f"{fork.upper()}_FORK_EPOCH", 2**64 - 1) <= epoch:
-                name = fork
-        return name
+        from lodestar_tpu.config import fork_name_at_epoch
+
+        return fork_name_at_epoch(self.cfg, slot // self.p.SLOTS_PER_EPOCH)
 
     def block_type_at_slot(self, slot: int):
         ns = getattr(self.types, self.fork_name_at_slot(slot))

@@ -119,7 +119,22 @@ async def _run_beacon(args) -> int:
         db = FileDbController(args.db + "/wal.log")
         try:
             anchor = load_anchor_state_from_db(db, p, chain_cfg)
-        except (OSError, ValueError) as e:
+            if anchor is None:
+                # non-empty datadir with hot blocks but no archive yet:
+                # refuse to interleave a fresh chain into the same wal
+                from lodestar_tpu.db import Bucket, Repository
+                from lodestar_tpu.ssz import uint64
+
+                hot = Repository(db, Bucket.allForks_block, uint64).keys(limit=1)
+                if hot:
+                    print(
+                        f"error: data directory {args.db} holds blocks but no archived "
+                        "state (node stopped before first finalization); delete the "
+                        "datadir or finish syncing with the original flags",
+                        file=sys.stderr,
+                    )
+                    return 1
+        except Exception as e:
             # a NON-EMPTY datadir that cannot be decoded must abort, not
             # silently start a fresh chain into the same wal (wrong
             # --preset / corruption would interleave two chains)
@@ -130,7 +145,12 @@ async def _run_beacon(args) -> int:
             )
             return 1
     if anchor is not None:
-        pass  # resumed from the data directory
+        if args.checkpoint_sync_url:
+            print(
+                "warning: --checkpoint-sync-url ignored — resuming from the data "
+                "directory's archived state (delete the datadir to re-anchor)",
+                file=sys.stderr,
+            )
     elif args.checkpoint_sync_url:
         import time as _time
 
@@ -139,8 +159,9 @@ async def _run_beacon(args) -> int:
 
         client = BeaconApiClient(args.checkpoint_sync_url)
         genesis_time = int(client.get_genesis()["data"]["genesis_time"])
-        seconds = 12  # mainnet SECONDS_PER_SLOT; dev presets are close enough for the wss gate
-        current_slot = max(0, int(_time.time()) - genesis_time) // seconds
+        current_slot = (
+            max(0, int(_time.time()) - genesis_time) // chain_cfg.SECONDS_PER_SLOT
+        )
         anchor = fetch_checkpoint_state(client, p=p, current_slot=current_slot)
     else:
         anchor = create_interop_genesis_state(args.genesis_validators, p=p)

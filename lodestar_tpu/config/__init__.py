@@ -100,8 +100,8 @@ class ForkInfo:
 def fork_name_at_epoch(cfg: ChainConfig, epoch: int) -> str:
     """Active fork name at an epoch for a plain ChainConfig (shared by
     the chain runtime and restart/checkpoint loaders)."""
-    name = "phase0"
-    for fork in ("altair", "bellatrix", "capella", "deneb"):
+    name = FORK_ORDER[0]
+    for fork in FORK_ORDER[1:]:
         if cfg.fork_epoch(fork) <= epoch:
             name = fork
     return name

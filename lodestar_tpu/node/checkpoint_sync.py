@@ -99,14 +99,32 @@ def load_anchor_state_from_db(db, p: BeaconPreset | None = None, cfg=None):
     raw = repo.get_binary(slot)
     if raw is None:
         return None
-    epoch = slot // p.SLOTS_PER_EPOCH
-    fork = "phase0"
+    from lodestar_tpu.db import encode_key
+
+    t = ssz_types(p)
+    # fork resolution: the archiver's recorded fork name is authoritative
+    # (a state's actual fork can lag the config schedule); fall back to
+    # the config guess, then probe forks newest->oldest
+    recorded = db.get(encode_key(Bucket.index_chainInfo, f"state_fork_{slot:020d}"))
+    candidates: list[str] = []
+    if recorded:
+        candidates.append(recorded.decode())
     if cfg is not None:
         from lodestar_tpu.config import fork_name_at_epoch
 
-        fork = fork_name_at_epoch(cfg, epoch)
-    t = ssz_types(p)
-    state = getattr(t, fork).BeaconState.deserialize(raw)
+        candidates.append(fork_name_at_epoch(cfg, slot // p.SLOTS_PER_EPOCH))
+    candidates += ["deneb", "capella", "bellatrix", "altair", "phase0"]
+    state = None
+    fork = None
+    for name in dict.fromkeys(candidates):  # dedup, keep priority order
+        try:
+            state = getattr(t, name).BeaconState.deserialize(raw)
+            fork = name
+            break
+        except (ValueError, KeyError, AttributeError):
+            continue
+    if state is None:
+        raise CheckpointSyncError(f"archived state at slot {slot} matches no known fork")
     get_logger(name="lodestar.checkpoint_sync").info(
         "resuming from archived state", {"slot": slot, "fork": fork}
     )

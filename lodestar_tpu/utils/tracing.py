@@ -1,0 +1,43 @@
+"""Offload-batch tracing: env-gated XLA profiler capture.
+
+SURVEY §5 tracing strategy: "keep the metric taxonomy, add XLA profiler
+traces per offload batch". Set `LODESTAR_TPU_TRACE=<dir>` and every
+traced region (device batch-verify launches, merkle offloads) writes an
+XLA profiler trace viewable in TensorBoard/xprof; unset, the context
+manager is free (no profiler import, no overhead).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+__all__ = ["trace_region", "tracing_enabled"]
+
+_TRACE_DIR = os.environ.get("LODESTAR_TPU_TRACE", "")
+# jax.profiler allows one capture at a time; try-acquire makes the guard
+# atomic across executor threads (concurrent regions no-op)
+_capture_lock = threading.Lock()
+
+
+def tracing_enabled() -> bool:
+    return bool(_TRACE_DIR)
+
+
+@contextlib.contextmanager
+def trace_region(name: str):
+    """XLA profiler capture around a device-offload region. Nested or
+    concurrent regions no-op (the profiler is single-capture); so does
+    everything when LODESTAR_TPU_TRACE is unset."""
+    if not _TRACE_DIR or not _capture_lock.acquire(blocking=False):
+        yield
+        return
+    import jax
+
+    out_dir = os.path.join(_TRACE_DIR, name)
+    try:
+        with jax.profiler.trace(out_dir):
+            yield
+    finally:
+        _capture_lock.release()

@@ -1,0 +1,33 @@
+"""Env-gated XLA profiler tracing around device offload regions."""
+
+from __future__ import annotations
+
+import os
+
+
+def test_trace_region_noop_and_gated(tmp_path, monkeypatch):
+    """trace_region: free no-op when unset; captures a profiler trace
+    directory when LODESTAR_TPU_TRACE points somewhere."""
+    import lodestar_tpu.utils.tracing as tracing
+
+    # unset -> pure no-op
+    monkeypatch.setattr(tracing, "_TRACE_DIR", "")
+    with tracing.trace_region("x"):
+        pass
+    assert not tracing.tracing_enabled()
+
+    # set -> a capture lands on disk
+    out = str(tmp_path / "traces")
+    monkeypatch.setattr(tracing, "_TRACE_DIR", out)
+    assert tracing.tracing_enabled()
+    import jax.numpy as jnp
+
+    with tracing.trace_region("unit"):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    import os
+
+    assert os.path.isdir(os.path.join(out, "unit"))
+    # nested regions no-op rather than fighting the single-capture profiler
+    with tracing.trace_region("outer"):
+        with tracing.trace_region("inner"):
+            pass
