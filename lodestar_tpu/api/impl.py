@@ -405,11 +405,19 @@ class BeaconApiImpl:
         return {"version": fork_of(st), "data": to_json(st.type, st)}
 
     def get_spec(self) -> dict:
+        """Preset constants PLUS the chain config's fork schedule and
+        timing — validator clients derive their signing domains from
+        this (reference config/spec includes *_FORK_VERSION/_EPOCH)."""
         p = self.p
         fields = {
             name: str(getattr(p, name))
             for name in type(p).__dataclass_fields__  # type: ignore[attr-defined]
         }
+        cfg = self.chain.cfg
+        if cfg is not None:
+            for name in type(cfg).__dataclass_fields__:  # type: ignore[attr-defined]
+                value = getattr(cfg, name)
+                fields[name] = "0x" + value.hex() if isinstance(value, bytes) else str(value)
         return {"data": fields}
 
 

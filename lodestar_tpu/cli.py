@@ -40,6 +40,16 @@ def _build_parser() -> argparse.ArgumentParser:
         help="trusted beacon API to anchor from (finalized state) instead of a dev genesis",
     )
 
+    val = sub.add_parser("validator", help="run a REST-mode validator client")
+    val.add_argument("--beacon-url", default="http://127.0.0.1:9596")
+    val.add_argument("--keystores", default=None, help="directory of EIP-2335 keystore JSON files")
+    val.add_argument("--password", default="", help="keystore password (all files)")
+    val.add_argument("--interop-keys", type=int, default=0, help="use N deterministic interop keys instead of keystores")
+    val.add_argument("--preset", default="mainnet", choices=["minimal", "mainnet"])
+    val.add_argument("--slots", type=int, default=0, help="stop after N slots (0 = run forever)")
+    val.add_argument("--keymanager-port", type=int, default=0, help="serve the keymanager API on this port")
+    val.add_argument("--data-dir", default=None, help="persist slashing protection here (STRONGLY recommended)")
+
     sub.add_parser("bench", help="run the device benchmark")
     return ap
 
@@ -187,12 +197,141 @@ async def _run_beacon(args) -> int:
     return 0
 
 
+async def _run_validator(args) -> int:
+    """REST-mode validator process (reference `validator` command:
+    keystores -> ValidatorStore -> duty loop against a beacon URL)."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    from lodestar_tpu import params
+    from lodestar_tpu.api.client import BeaconApiClient
+    from lodestar_tpu.config import create_beacon_config, mainnet_chain_config, minimal_chain_config
+    from lodestar_tpu.crypto.bls.api import SecretKey
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.validator import SlashingProtection, ValidatorStore
+    from lodestar_tpu.validator.keystore import decrypt_keystore
+    from lodestar_tpu.validator.rest_client import RestValidator
+
+    params.set_active_preset(args.preset)
+    p = params.active_preset()
+    chain_cfg = minimal_chain_config() if args.preset == "minimal" else mainnet_chain_config()
+
+    sks = []
+    if args.interop_keys:
+        from lodestar_tpu.state_transition.genesis import interop_secret_keys
+
+        sks = interop_secret_keys(args.interop_keys)
+    elif args.keystores:
+        for fname in sorted(_os.listdir(args.keystores)):
+            if not fname.endswith(".json"):
+                continue
+            with open(_os.path.join(args.keystores, fname)) as f:
+                ks = _json.load(f)
+            sks.append(SecretKey.from_bytes(decrypt_keystore(ks, args.password)))
+    if not sks:
+        print("error: no keys (use --keystores or --interop-keys)", file=sys.stderr)
+        return 1
+
+    client = BeaconApiClient(args.beacon_url)
+    genesis = client.get_genesis()["data"]
+    # adopt the NODE's fork schedule/timing: signing domains must match the
+    # chain we attach to, not the local preset defaults (reference
+    # validator asserts config compatibility via /eth/v1/config/spec)
+    try:
+        spec = client.get_spec()["data"]
+        node_preset = spec.get("PRESET_BASE", args.preset)
+        if node_preset not in (args.preset, "custom"):
+            print(
+                f"error: node runs preset {node_preset!r} but --preset is "
+                f"{args.preset!r}; epoch math would disagree — restart with "
+                f"--preset {node_preset}",
+                file=sys.stderr,
+            )
+            return 1
+        overrides = {}
+        for name in type(chain_cfg).__dataclass_fields__:
+            if name not in spec:
+                continue
+            value = spec[name]
+            current = getattr(chain_cfg, name)
+            if isinstance(current, bytes):
+                overrides[name] = bytes.fromhex(value[2:] if value.startswith("0x") else value)
+            elif isinstance(current, int):
+                overrides[name] = int(value)
+            else:
+                overrides[name] = value
+        chain_cfg = chain_cfg.replace(**overrides)
+    except Exception as e:
+        print(f"warning: could not adopt node spec, using local config: {e}", file=sys.stderr)
+    cfg = create_beacon_config(chain_cfg, bytes.fromhex(genesis["genesis_validators_root"][2:]))
+    if args.data_dir:
+        import os as _os2
+
+        from lodestar_tpu.db import FileDbController
+
+        _os2.makedirs(args.data_dir, exist_ok=True)
+        slashing_db = FileDbController(args.data_dir + "/slashing_protection.log")
+    else:
+        print(
+            "warning: no --data-dir — slashing protection is IN MEMORY and "
+            "lost on restart",
+            file=sys.stderr,
+        )
+        slashing_db = MemoryDbController()
+    store = ValidatorStore(cfg, SlashingProtection(slashing_db), sks, p)
+    rv = RestValidator(client=client, store=store, p=p)
+
+    km_server = None
+    if args.keymanager_port:
+        from lodestar_tpu.validator.keymanager import KeymanagerApi, create_keymanager_server
+
+        km = KeymanagerApi(store, genesis_validators_root=bytes.fromhex(genesis["genesis_validators_root"][2:]))
+        km_server = create_keymanager_server(km, port=args.keymanager_port)
+        km_server.start()
+        print(f"keymanager API on :{km_server.port}")
+
+    genesis_time = int(genesis["genesis_time"])
+    seconds = int(chain_cfg.SECONDS_PER_SLOT)
+    print(f"validator client up: {len(sks)} keys against {args.beacon_url}")
+    ran = 0
+    try:
+        while args.slots == 0 or ran < args.slots:
+            now = _time.time()
+            if now < genesis_time + seconds:
+                # pre-genesis / slot 0: wait for the slot-1 window rather
+                # than running duties early and skipping them later
+                await asyncio.sleep(min(2.0, genesis_time + seconds - now + 0.1))
+                continue
+            slot = (int(now) - genesis_time) // seconds
+            try:
+                out = rv.run_slot_duties(slot)
+                if out["proposed"] is not None or out["attestations"]:
+                    print(
+                        f"slot {slot}: proposed={'yes' if out['proposed'] else 'no'} "
+                        f"atts={len(out['attestations'])}"
+                    )
+            except Exception as e:
+                print(f"slot {slot}: duty error: {e}", file=sys.stderr)
+            ran += 1
+            next_slot_at = genesis_time + (slot + 1) * seconds
+            await asyncio.sleep(max(0.2, next_slot_at - _time.time()))
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        if km_server is not None:
+            km_server.stop()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "dev":
         return asyncio.run(_run_dev(args))
     if args.cmd == "beacon":
         return asyncio.run(_run_beacon(args))
+    if args.cmd == "validator":
+        return asyncio.run(_run_validator(args))
     if args.cmd == "bench":
         import os
 

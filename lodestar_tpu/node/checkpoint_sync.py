@@ -109,10 +109,23 @@ def load_anchor_state_from_db(db, p: BeaconPreset | None = None, cfg=None):
     candidates: list[str] = []
     if recorded:
         candidates.append(recorded.decode())
+    # every BeaconState starts genesis_time u64 | gvr 32 | slot u8*8 |
+    # fork(prev 4 | current 4 | epoch 8): read the state's self-declared
+    # current fork version straight from the bytes
+    current_version = bytes(raw[52:56])
     if cfg is not None:
-        from lodestar_tpu.config import fork_name_at_epoch
+        from lodestar_tpu.config import FORK_ORDER
 
-        candidates.append(fork_name_at_epoch(cfg, slot // p.SLOTS_PER_EPOCH))
+        for name in reversed(FORK_ORDER):
+            if cfg.fork_version(name) == current_version:
+                candidates.append(name)
+                break
+    elif current_version and current_version[0] < 5:
+        from lodestar_tpu.config import FORK_ORDER
+
+        candidates.append(FORK_ORDER[current_version[0]])
+    # last resort: capella/deneb share a layout, so blind probing can
+    # mis-tag — it only runs when nothing above matched
     candidates += ["deneb", "capella", "bellatrix", "altair", "phase0"]
     state = None
     fork = None

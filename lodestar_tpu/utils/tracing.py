@@ -30,14 +30,33 @@ def trace_region(name: str):
     """XLA profiler capture around a device-offload region. Nested or
     concurrent regions no-op (the profiler is single-capture); so does
     everything when LODESTAR_TPU_TRACE is unset."""
-    if not _TRACE_DIR or not _capture_lock.acquire(blocking=False):
+    if not _TRACE_DIR:
         yield
         return
-    import jax
-
-    out_dir = os.path.join(_TRACE_DIR, name)
     try:
-        with jax.profiler.trace(out_dir):
+        import jax
+    except Exception:
+        yield
+        return
+    if not _capture_lock.acquire(blocking=False):
+        yield
+        return
+    # profiler failures must never change the traced region's outcome
+    # (a raise here would masquerade as e.g. an invalid signature batch)
+    try:
+        started = False
+        try:
+            jax.profiler.start_trace(os.path.join(_TRACE_DIR, name))
+            started = True
+        except Exception:
+            pass
+        try:
             yield
+        finally:
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
     finally:
         _capture_lock.release()
