@@ -31,6 +31,24 @@ class BeaconApiImpl:
         self.p = chain.p
         self.t = ssz_types(chain.p)
 
+    def _run_async(self, coro):
+        """Run a chain-mutating coroutine on the NODE's event loop when
+        one is attached (chain.loop, set by BeaconNode.init). REST
+        handler threads must not drive loop-bound machinery (the device
+        BLS pool's queues/timers live on the main loop) nor mutate chain
+        structures concurrently with the gossip drain; routing through
+        the loop restores the reference's single-threaded semantics.
+        Library users without a node fall back to a private loop."""
+        loop = getattr(self.chain, "loop", None)
+        if loop is not None and loop.is_running():
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is not loop:
+                return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=120)
+        return asyncio.run(coro)
+
     # -- events namespace (SSE) -----------------------------------------------
 
     def stream_events(self, topics: list[str]) -> "EventStream":
@@ -226,7 +244,7 @@ class BeaconApiImpl:
         from lodestar_tpu.chain.chain import BlockError
 
         try:
-            asyncio.run(self.chain.process_block(signed))
+            self._run_async(self.chain.process_block(signed))
         except BlockError as e:
             raise ApiError(400, str(e)) from e
         return {}
@@ -281,7 +299,7 @@ class BeaconApiImpl:
                     continue
                 import_verified_attestation(self.chain, res, att)
 
-        asyncio.run(run_batch())
+        self._run_async(run_batch())
         if errors:
             raise ApiError(400, f"some attestations failed: {errors}")
         return {}

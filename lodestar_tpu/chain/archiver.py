@@ -27,6 +27,45 @@ def _unhex(s: str) -> bytes:
     return bytes.fromhex(s[2:])
 
 
+def decode_archived_state(db: DbController, types, raw: bytes, slot: int, *, cfg=None, p=None):
+    """Decode a slot-keyed archived state: the archiver's recorded fork
+    name is authoritative, then the state's own fork version bytes
+    (every BeaconState starts genesis_time u64 | gvr 32 | slot 8 |
+    fork{prev4 current4 ...}), then the config schedule. Shared by the
+    in-process cold reads and the restart-from-db loader so the record
+    format lives in ONE place."""
+    candidates: list[str] = []
+    recorded = db.get(encode_key(Bucket.index_chainInfo, f"state_fork_{slot:020d}"))
+    if recorded:
+        candidates.append(recorded.decode())
+    current_version = bytes(raw[52:56]) if len(raw) >= 56 else b""
+    if cfg is not None:
+        from lodestar_tpu.config import FORK_ORDER, fork_name_at_epoch
+
+        for name in reversed(FORK_ORDER):
+            if cfg.fork_version(name) == current_version:
+                candidates.append(name)
+                break
+        if p is not None:
+            candidates.append(fork_name_at_epoch(cfg, slot // p.SLOTS_PER_EPOCH))
+    elif current_version and current_version[0] < 5:
+        from lodestar_tpu.config import FORK_ORDER
+
+        candidates.append(FORK_ORDER[current_version[0]])
+    # blind probe last (capella/deneb share a layout — only reached when
+    # nothing above matched)
+    candidates += ["deneb", "capella", "bellatrix", "altair", "phase0"]
+    for name in dict.fromkeys(candidates):
+        ns = getattr(types, name, None)
+        if ns is None:
+            continue
+        try:
+            return ns.BeaconState.deserialize(raw), name
+        except (ValueError, KeyError):
+            continue
+    return None, None
+
+
 class StatesArchiver:
     """Persist finalized states on the epoch-frequency cadence
     (reference archiveStates.ts:27)."""
@@ -171,10 +210,10 @@ class Archiver:
 
     def _decode_state(self, slot: int, raw: bytes):
         chain = self.chain
-        recorded = self.db.get(encode_key(Bucket.index_chainInfo, f"state_fork_{slot:020d}"))
-        fork = recorded.decode() if recorded else chain.fork_name_at_slot(slot)
-        state_type = getattr(chain.types, fork).BeaconState
-        return state_type.deserialize(raw)
+        state, _fork = decode_archived_state(
+            self.db, chain.types, raw, slot, cfg=chain.cfg, p=chain.p
+        )
+        return state
 
     def get_archived_block_by_slot(self, slot: int):
         raw = self.block_archive.get_binary(int(slot))

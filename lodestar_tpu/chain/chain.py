@@ -242,12 +242,21 @@ class BeaconChain:
 
     def get_block_by_root(self, block_root: bytes):
         """Fork-aware decode from the hot block db, falling through to
-        the finalized archive (root index -> slot -> cold bucket)."""
+        the finalized archive (root index -> slot -> cold bucket). When
+        the proto node is gone (pruned orphan), the slot is read straight
+        from the serialized block — every SignedBeaconBlock starts
+        offset4 | signature96 | message{slot u64le} — so the right fork
+        container is still chosen."""
         raw = self.blocks_db.get_binary(block_root)
         if raw is None:
             return self.archiver.get_archived_block_by_root(block_root)
         node = self.fork_choice.proto_array.get_block(_hex(block_root))
-        slot = node.slot if node is not None else 0
+        if node is not None:
+            slot = node.slot
+        elif len(raw) >= 108:
+            slot = int.from_bytes(raw[100:108], "little")
+        else:
+            slot = 0
         _, signed_type = self.block_type_at_slot(slot)
         return signed_type.deserialize(raw)
 
@@ -366,7 +375,12 @@ class BeaconChain:
         if not sigs_ok:
             raise BlockError(BlockErrorCode.INVALID_SIGNATURES, _hex(block_root))
 
-        # 4. import (importBlock.ts:51)
+        # 4. import (importBlock.ts:51). Re-check ALREADY_KNOWN: another
+        # task may have imported the same block while this one awaited
+        # signature verification (asyncio interleaves at awaits; the
+        # RLock only excludes across threads)
+        if self.fork_choice.proto_array.has_block(_hex(block_root)):
+            raise BlockError(BlockErrorCode.ALREADY_KNOWN, _hex(block_root))
         self.blocks_db.put_binary(block_root, signed_type.serialize(signed_block))
         self.state_cache.add(block_root, post_state)
 
@@ -471,11 +485,16 @@ class BeaconChain:
         """State at the finalized checkpoint: hot cache, else regen from
         the finalized block (still in fork choice), else replay the
         archived canonical blocks forward from the newest archived state
-        — never a silently-stale snapshot."""
+        — never a silently-stale snapshot. The cold replay can be tens
+        of thousands of STF steps (archive cadence), so its result is
+        memoized per finalized root."""
         root = bytes.fromhex(self.fork_choice.finalized.root[2:])
         st = self.state_cache.get(root)
         if st is not None:
             return st
+        memo = getattr(self, "_finalized_replay_memo", None)
+        if memo is not None and memo[0] == root:
+            return memo[1]
         try:
             return self.get_state_by_block_root(root)
         except BlockError:
@@ -506,4 +525,5 @@ class BeaconChain:
             and self.types.BeaconBlockHeader.hash_tree_root(header) == root
         ):
             self.state_cache.add(root, st)
+        self._finalized_replay_memo = (root, st)
         return st

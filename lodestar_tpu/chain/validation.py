@@ -270,19 +270,20 @@ _SUBCOMMITTEE_CACHE: dict = {}
 
 
 def _committee_for_slot(state, slot: int, p):
-    """current_sync_committee, or next_ when the message's slot falls in
-    the period after the head state's — validators begin signing with
-    the new committee at the boundary while the head still lags a slot
-    (reference syncCommittee.ts getSyncCommitteeValidatorIndexMap uses
-    the state at the message's epoch). A message from the PREVIOUS
-    period is unverifiable from this state (the old committee is gone)
-    — IGNORE it rather than REJECT-penalizing an honest boundary peer."""
+    """The committee that signs sync messages AT `slot`: their aggregate
+    lands in the block at slot+1 and verifies against THAT state's
+    current committee, so the last slot of every period is signed by the
+    rotated (next) committee — matching the duty producer
+    (validator/__init__.py _run_sync_duties) and process_sync_aggregate.
+    A message whose inclusion period precedes the head state's is
+    unverifiable from here (the old committee is gone) — IGNORE it
+    rather than REJECT-penalizing an honest boundary peer."""
     period_len = p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * p.SLOTS_PER_EPOCH
-    msg_period = int(slot) // period_len
+    inclusion_period = (int(slot) + 1) // period_len
     state_period = int(state.slot) // period_len
-    if msg_period == state_period + 1:
+    if inclusion_period == state_period + 1:
         return state.next_sync_committee
-    if msg_period < state_period:
+    if inclusion_period < state_period:
         raise GossipValidationError(
             GossipAction.IGNORE, "message from a previous sync-committee period"
         )

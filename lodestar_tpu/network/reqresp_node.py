@@ -84,15 +84,25 @@ class ReqRespBeaconNode(ReqResp):
         if req.count == 0 or req.step != 1:
             raise ReqRespError("invalid range request")
         count = min(req.count, MAX_REQUEST_BLOCKS_PER_CALL)
-        # canonical walk: collect head-chain nodes within [start, start+count)
+        lo, hi = req.start_slot, req.start_slot + count
+        # canonical walk: collect head-chain nodes within [lo, hi)
         fc = self.chain.fork_choice.proto_array
         node = fc.get_block(self.chain.fork_choice.head)
         wanted = []
-        lo, hi = req.start_slot, req.start_slot + count
         while node is not None and node.slot >= lo:
             if node.slot < hi:
                 wanted.append(node)
             node = fc.nodes[node.parent] if node.parent is not None else None
+        hot_slots = {n.slot for n in wanted}
+        # finalized history lives in the slot-keyed archive after the
+        # archiver migrates + fork choice prunes — serve it from there
+        # (reference BeaconDb blockArchive range reads)
+        for slot in range(lo, hi):
+            if slot in hot_slots:
+                break  # the hot walk covers the rest of the range
+            signed = self.chain.archiver.get_archived_block_by_slot(slot)
+            if signed is not None:
+                yield signed
         for n in reversed(wanted):
             signed = self.chain.get_block_by_root(bytes.fromhex(n.block_root[2:]))
             if signed is not None:

@@ -142,7 +142,12 @@ class BeaconNode:
         if not opts.manual_clock:
             clock.start()
 
-        # 6. gossip processor (network ingress -> validated dispatch)
+        # 6. gossip processor (network ingress -> validated dispatch);
+        # the chain remembers the node's loop so REST handler threads can
+        # route mutations onto it (single-threaded chain semantics)
+        import asyncio as _asyncio
+
+        chain.loop = _asyncio.get_running_loop()
         from lodestar_tpu.network.processor import NetworkProcessor
 
         processor = NetworkProcessor(chain)

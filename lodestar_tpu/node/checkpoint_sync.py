@@ -99,43 +99,10 @@ def load_anchor_state_from_db(db, p: BeaconPreset | None = None, cfg=None):
     raw = repo.get_binary(slot)
     if raw is None:
         return None
-    from lodestar_tpu.db import encode_key
+    from lodestar_tpu.chain.archiver import decode_archived_state
 
     t = ssz_types(p)
-    # fork resolution: the archiver's recorded fork name is authoritative
-    # (a state's actual fork can lag the config schedule); fall back to
-    # the config guess, then probe forks newest->oldest
-    recorded = db.get(encode_key(Bucket.index_chainInfo, f"state_fork_{slot:020d}"))
-    candidates: list[str] = []
-    if recorded:
-        candidates.append(recorded.decode())
-    # every BeaconState starts genesis_time u64 | gvr 32 | slot u8*8 |
-    # fork(prev 4 | current 4 | epoch 8): read the state's self-declared
-    # current fork version straight from the bytes
-    current_version = bytes(raw[52:56])
-    if cfg is not None:
-        from lodestar_tpu.config import FORK_ORDER
-
-        for name in reversed(FORK_ORDER):
-            if cfg.fork_version(name) == current_version:
-                candidates.append(name)
-                break
-    elif current_version and current_version[0] < 5:
-        from lodestar_tpu.config import FORK_ORDER
-
-        candidates.append(FORK_ORDER[current_version[0]])
-    # last resort: capella/deneb share a layout, so blind probing can
-    # mis-tag — it only runs when nothing above matched
-    candidates += ["deneb", "capella", "bellatrix", "altair", "phase0"]
-    state = None
-    fork = None
-    for name in dict.fromkeys(candidates):  # dedup, keep priority order
-        try:
-            state = getattr(t, name).BeaconState.deserialize(raw)
-            fork = name
-            break
-        except (ValueError, KeyError, AttributeError):
-            continue
+    state, fork = decode_archived_state(db, t, raw, slot, cfg=cfg, p=p)
     if state is None:
         raise CheckpointSyncError(f"archived state at slot {slot} matches no known fork")
     get_logger(name="lodestar.checkpoint_sync").info(

@@ -42,6 +42,7 @@ class RestValidator:
         self.log = get_logger(name="lodestar.validator.rest")
         # validator index -> pubkey for OUR keys, filled lazily from the API
         self._index_to_pubkey: dict[int, bytes] = {}
+        self._indices_epoch = -1
 
     def _may_sign(self, pubkey: bytes) -> bool:
         if not self.store.has_pubkey(pubkey):
@@ -61,10 +62,14 @@ class RestValidator:
     def run_slot_duties(self, slot: int) -> dict:
         """Propose (if selected) then attest for `slot`. Synchronous —
         the REST calls are blocking; callers schedule per slot."""
-        if not self._index_to_pubkey:
-            self.refresh_indices()
-        out = {"proposed": None, "attestations": []}
         epoch = slot // self.p.SLOTS_PER_EPOCH
+        if epoch != self._indices_epoch:
+            # re-poll once per epoch: keymanager imports and fresh
+            # activations must start performing duties without a restart
+            # (reference indicesService.pollValidatorIndices cadence)
+            self.refresh_indices()
+            self._indices_epoch = epoch
+        out = {"proposed": None, "attestations": []}
         t = ssz_types(self.p)
 
         # -- proposal (services/block.ts over the API) --
