@@ -17,6 +17,9 @@ __all__ = ["Archiver", "StatesArchiver"]
 
 # reference cli default `chain.archiveStateEpochFrequency` (1024 epochs)
 DEFAULT_ARCHIVE_STATE_EPOCH_FREQUENCY = 1024
+# spec MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS: sidecars older than this
+# are prunable (reference archiveBlocks.ts blob expiry)
+MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS = 4096
 
 
 def _hex(b: bytes) -> str:
@@ -168,12 +171,23 @@ class Archiver:
             chain.blocks_db.delete(block_root)
             migrated += 1
 
-        # dead forks at or below the finalized slot leave the hot db
+        # dead forks at or below the finalized slot leave the hot db,
+        # their sidecars with them
         dropped = 0
         for node in non_canonical:
             if node.slot <= finalized_slot:
                 chain.blocks_db.delete(_unhex(node.block_root))
+                chain.blobs_db.delete(_unhex(node.block_root))
                 dropped += 1
+
+        # blob retention window (spec MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS)
+        floor_slot = (
+            int(finalized_cp.epoch) - MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS
+        ) * chain.p.SLOTS_PER_EPOCH
+        if floor_slot > 0:
+            for key, sidecar in list(chain.blobs_db.entries()):
+                if int(sidecar.beacon_block_slot) < floor_slot:
+                    chain.blobs_db.delete(bytes(key))
 
         if migrated or dropped:
             self.log.debug(

@@ -110,6 +110,11 @@ class BeaconChain:
         self.types = t
 
         self.blocks_db: Repository = Repository(db, Bucket.allForks_block, t.phase0.SignedBeaconBlock)
+        # coupled early-4844 sidecars, keyed by block root (reference
+        # db allForks_blobsSidecar)
+        self.blobs_db: Repository = Repository(
+            db, Bucket.allForks_blobsSidecar, t.deneb.BlobsSidecar
+        )
         self.states_db: Repository = Repository(db, Bucket.allForks_stateArchive, anchor_state.type)
 
         self.state_cache = StateCache()
@@ -480,6 +485,12 @@ class BeaconChain:
 
     def get_head_state(self):
         return self.get_state_by_block_root(self.head_root)
+
+    def put_blobs_sidecar(self, sidecar) -> None:
+        self.blobs_db.put(bytes(sidecar.beacon_block_root), sidecar)
+
+    def get_blobs_sidecar(self, block_root: bytes):
+        return self.blobs_db.get(bytes(block_root))
 
     def get_finalized_state(self):
         """State at the finalized checkpoint: hot cache, else regen from

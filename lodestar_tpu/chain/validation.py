@@ -231,8 +231,8 @@ def validate_gossip_block(chain, signed_block) -> None:
     root_hex = "0x" + bytes(block.parent_root).hex()
     if chain.fork_choice.proto_array.get_block(root_hex) is None:
         raise GossipValidationError(GossipAction.IGNORE, "parent unknown")
-    t = chain.types
-    block_root = t.phase0.BeaconBlock.hash_tree_root(block)
+    block_type, _signed = chain.block_type_at_slot(int(block.slot))
+    block_root = block_type.hash_tree_root(block)
     if chain.fork_choice.proto_array.has_block("0x" + block_root.hex()):
         raise GossipValidationError(GossipAction.IGNORE, "already known")
 
@@ -439,3 +439,54 @@ def validate_sync_committee_contribution(chain, signed) -> SyncCommitteeValidati
         signature_sets=[selection_set, outer_set, contribution_set],
         register_seen=lambda: chain.seen_sync_aggregators.add(slot, ai, subnet),
     )
+
+
+def validate_gossip_block_and_blobs_sidecar(chain, signed_coupled) -> None:
+    """beacon_block_and_blobs_sidecar topic (reference
+    `validation/blobsSidecar.ts validateGossipBlobsSidecar` + the block
+    checks): commitments are valid G1 points, match the payload's blob
+    transactions, and the coupled sidecar's aggregate KZG proof verifies
+    against the block's commitments."""
+    from lodestar_tpu.crypto.bls import curve as _curve
+    from lodestar_tpu.crypto.bls.serdes import PointDecodeError, g1_from_bytes
+    from lodestar_tpu.crypto.kzg import KzgError, validate_blobs_sidecar
+    from lodestar_tpu.state_transition.deneb import (
+        verify_kzg_commitments_against_transactions,
+    )
+
+    signed_block = signed_coupled.beacon_block
+    sidecar = signed_coupled.blobs_sidecar
+    block = signed_block.message
+    validate_gossip_block(chain, signed_block)
+
+    commitments = [bytes(c) for c in block.body.blob_kzg_commitments]
+    # [REJECT] commitments KeyValidate: decodable G1 points IN the
+    # subgroup (g1_from_bytes raises on malformed encodings and defers
+    # the subgroup check to the caller)
+    for i, c in enumerate(commitments):
+        try:
+            pt = g1_from_bytes(c)
+        except PointDecodeError as e:
+            raise GossipValidationError(
+                GossipAction.REJECT, f"bad KZG commitment {i}: {e}"
+            ) from e
+        if pt is not None and not _curve.g1_in_subgroup(pt):
+            raise GossipValidationError(
+                GossipAction.REJECT, f"KZG commitment {i} outside subgroup"
+            )
+    # [REJECT] commitments match the blob transactions' versioned hashes
+    try:
+        verify_kzg_commitments_against_transactions(
+            list(block.body.execution_payload.transactions), commitments
+        )
+    except Exception as e:
+        raise GossipValidationError(GossipAction.REJECT, f"commitments vs txs: {e}") from e
+    # [REJECT] coupled sidecar binds to this block and its proof verifies
+    t = chain.types
+    block_root = t.deneb.BeaconBlock.hash_tree_root(block)
+    try:
+        validate_blobs_sidecar(
+            int(block.slot), block_root, commitments, sidecar
+        )
+    except KzgError as e:
+        raise GossipValidationError(GossipAction.REJECT, f"blobs sidecar: {e}") from e

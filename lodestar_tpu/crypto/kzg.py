@@ -27,7 +27,7 @@ from . import bls  # noqa: F401  (package marker)
 from .bls import curve as C
 from .bls.fields import R
 from .bls.pairing import pairings_are_one
-from .bls.serdes import g1_from_bytes, g1_to_bytes, g2_from_bytes
+from .bls.serdes import PointDecodeError, g1_from_bytes, g1_to_bytes, g2_from_bytes
 
 __all__ = [
     "load_trusted_setup",
@@ -198,14 +198,18 @@ def _commit_msm(g1, scalars, device: bool) -> bytes:
 
 
 def verify_kzg_proof(commitment: bytes, z: int, y: int, proof: bytes) -> bool:
-    """Pairing check e(P - [y]G1, -G2) * e(proof, [tau]G2 - [z]G2) == 1."""
+    """Pairing check e(P - [y]G1, -G2) * e(proof, [tau]G2 - [z]G2) == 1.
+    Malformed or out-of-subgroup points fail verification (spec
+    validate_kzg_g1) rather than raising."""
     _, g2 = load_trusted_setup()
-    c_pt = g1_from_bytes(commitment)
-    proof_pt = g1_from_bytes(proof)
-    if commitment != bytes([0xC0]) + bytes(47) and c_pt is None:
+    try:
+        c_pt = g1_from_bytes(commitment)
+        proof_pt = g1_from_bytes(proof)
+    except PointDecodeError:
         return False
-    if proof != bytes([0xC0]) + bytes(47) and proof_pt is None:
-        return False
+    for pt in (c_pt, proof_pt):
+        if pt is not None and not C.g1_in_subgroup(pt):
+            return False
 
     # X - [z] in G2: tau_g2 - z*g2_gen
     tau_g2 = g2[1]
@@ -256,3 +260,127 @@ def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes) -> bool:
     z = _compute_challenge(blob, commitment)
     y = _evaluate_blob_at(scalars, z)
     return verify_kzg_proof(commitment, z, y, proof)
+
+
+# --- early-4844 aggregate proofs (coupled BlobsSidecar) -----------------------
+# The reference v1.8.0 ships the EARLY EIP-4844 p2p design: one coupled
+# `BlobsSidecar` per block carrying ALL blobs + ONE aggregated proof,
+# verified by `validate_blobs_sidecar` (c-kzg verifyAggregateKzgProof —
+# reference `chain/validation/blobsSidecar.ts:68`). Aggregation follows
+# the early spec: blobs/commitments are folded with powers of one
+# Fiat-Shamir scalar, then a single opening at a second challenge.
+
+G1_INFINITY_BYTES = bytes([0xC0]) + bytes(47)
+
+
+def _commit_evals(scalars: list[int], device: bool) -> bytes:
+    """Commit an evaluation-form (bit-reversed domain) polynomial."""
+    g1, _ = load_trusted_setup()
+    n = len(scalars)
+    evals_natural = [0] * n
+    for i, v in enumerate(scalars):
+        evals_natural[_bit_reverse(i, n)] = v
+    return _commit_msm(g1, _inverse_ntt(evals_natural), device)
+
+
+def _hash_to_field(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+
+
+def _aggregate(blob_scalar_lists: list[list[int]], commitments: list[bytes]):
+    """(aggregated eval-form scalars, aggregated commitment point) via
+    powers of the folding challenge r (early spec
+    compute_aggregated_poly_and_commitment)."""
+    n = len(blob_scalar_lists)
+    h = hashlib.sha256()
+    h.update(FIAT_SHAMIR_PROTOCOL_DOMAIN + n.to_bytes(16, "big"))
+    for scalars in blob_scalar_lists:
+        for s in scalars:
+            h.update(s.to_bytes(32, "big"))
+    for c in commitments:
+        h.update(bytes(c))
+    r = int.from_bytes(h.digest(), "big") % R
+    powers = [pow(r, i, R) for i in range(n)]
+    width = len(blob_scalar_lists[0])
+    agg = [0] * width
+    for coeff, scalars in zip(powers, blob_scalar_lists):
+        for i, s in enumerate(scalars):
+            agg[i] = (agg[i] + coeff * s) % R
+    agg_commitment = None
+    for coeff, c in zip(powers, commitments):
+        try:
+            pt = g1_from_bytes(bytes(c))
+        except PointDecodeError as e:
+            raise KzgError(f"malformed commitment: {e}") from e
+        if pt is not None and not C.g1_in_subgroup(pt):
+            raise KzgError("commitment outside the G1 subgroup")
+        if pt is not None and coeff:
+            agg_commitment = C.g1_add(agg_commitment, C.g1_mul(pt, coeff))
+    return agg, agg_commitment, r
+
+
+def _opening_challenge(agg_scalars: list[int], agg_commitment_bytes: bytes) -> int:
+    h = hashlib.sha256()
+    h.update(FIAT_SHAMIR_PROTOCOL_DOMAIN + b"\x01")
+    for s in agg_scalars:
+        h.update(s.to_bytes(32, "big"))
+    h.update(agg_commitment_bytes)
+    return int.from_bytes(h.digest(), "big") % R
+
+
+def compute_aggregate_kzg_proof(blobs: list[bytes], *, device: bool = True) -> bytes:
+    """One proof for all of a block's blobs (early spec
+    compute_aggregate_kzg_proof; c-kzg computeAggregateKzgProof)."""
+    if not blobs:
+        return G1_INFINITY_BYTES
+    blob_scalars = [_blob_to_scalars(b) for b in blobs]
+    commitments = [blob_to_kzg_commitment(b, device=device) for b in blobs]
+    agg, agg_pt, _r = _aggregate(blob_scalars, commitments)
+    x = _opening_challenge(agg, g1_to_bytes(agg_pt))
+    y = _evaluate_blob_at(agg, x)
+    # quotient in evaluation form: q_i = (p_i - y) / (w_i - x)
+    roots = compute_roots_of_unity(len(agg))
+    q = [
+        (p_i - y) % R * pow((w - x) % R, R - 2, R) % R
+        for p_i, w in zip(agg, roots)
+    ]
+    return _commit_evals(q, device)
+
+
+def verify_aggregate_kzg_proof(
+    blobs: list[bytes], commitments: list[bytes], proof: bytes
+) -> bool:
+    """Early spec verify_aggregate_kzg_proof (the check inside
+    validate_blobs_sidecar)."""
+    if len(blobs) != len(commitments):
+        return False
+    if not blobs:
+        return bytes(proof) == G1_INFINITY_BYTES
+    try:
+        blob_scalars = [_blob_to_scalars(b) for b in blobs]
+        agg, agg_pt, _r = _aggregate(blob_scalars, [bytes(c) for c in commitments])
+        x = _opening_challenge(agg, g1_to_bytes(agg_pt))
+        y = _evaluate_blob_at(agg, x)
+        return verify_kzg_proof(g1_to_bytes(agg_pt), x, y, bytes(proof))
+    except (KzgError, PointDecodeError):
+        return False
+
+
+def validate_blobs_sidecar(
+    slot: int, beacon_block_root: bytes, expected_kzg_commitments: list[bytes], sidecar
+) -> None:
+    """Spec validate_blobs_sidecar (reference blobsSidecar.ts:73): slot
+    and root binding, blob count, aggregate proof. Raises KzgError."""
+    if int(sidecar.beacon_block_slot) != int(slot):
+        raise KzgError("sidecar slot mismatch")
+    if bytes(sidecar.beacon_block_root) != bytes(beacon_block_root):
+        raise KzgError("sidecar block root mismatch")
+    blobs = [bytes(b) for b in sidecar.blobs]
+    if len(blobs) != len(expected_kzg_commitments):
+        raise KzgError(
+            f"{len(blobs)} blobs vs {len(expected_kzg_commitments)} commitments"
+        )
+    if not verify_aggregate_kzg_proof(
+        blobs, [bytes(c) for c in expected_kzg_commitments], bytes(sidecar.kzg_aggregated_proof)
+    ):
+        raise KzgError("aggregate KZG proof failed verification")

@@ -24,6 +24,7 @@ MAX_JOBS_SUBMITTED_PER_TICK = 128
 # topic -> (max_length, "FIFO"|"LIFO")  (reference gossipQueues.ts:37-60)
 GOSSIP_QUEUE_OPTS: dict[str, tuple[int, str]] = {
     "beacon_block": (1024, "FIFO"),
+    "beacon_block_and_blobs_sidecar": (1024, "FIFO"),
     "beacon_aggregate_and_proof": (5120, "LIFO"),
     "beacon_attestation": (24576, "LIFO"),
     "voluntary_exit": (4096, "FIFO"),
@@ -38,6 +39,7 @@ GOSSIP_QUEUE_OPTS: dict[str, tuple[int, str]] = {
 # (reference executeGossipWorkOrderObj bypassQueue)
 EXECUTE_ORDER = (
     "beacon_block",
+    "beacon_block_and_blobs_sidecar",
     "beacon_aggregate_and_proof",
     "beacon_attestation",
     "sync_committee_contribution_and_proof",
@@ -47,7 +49,7 @@ EXECUTE_ORDER = (
     "attester_slashing",
     "bls_to_execution_change",
 )
-BYPASS_BACKPRESSURE = {"beacon_block"}
+BYPASS_BACKPRESSURE = {"beacon_block", "beacon_block_and_blobs_sidecar"}
 
 
 @dataclass
@@ -221,6 +223,18 @@ def default_gossip_handlers(chain) -> dict:
             return  # duplicates / future / parent-unknown are benign
         await chain.process_block(message, is_timely=True)
 
+    async def on_block_and_blobs(message, peer):
+        from lodestar_tpu.chain.validation import validate_gossip_block_and_blobs_sidecar
+
+        try:
+            validate_gossip_block_and_blobs_sidecar(chain, message)
+        except GossipValidationError as e:
+            if e.action is GossipAction.REJECT:
+                raise
+            return
+        await chain.process_block(message.beacon_block, is_timely=True)
+        chain.put_blobs_sidecar(message.blobs_sidecar)
+
     async def on_attestation(message, peer):
         try:
             res = validate_gossip_attestation(chain, message)
@@ -331,6 +345,7 @@ def default_gossip_handlers(chain) -> dict:
 
     return {
         "beacon_block": on_block,
+        "beacon_block_and_blobs_sidecar": on_block_and_blobs,
         "beacon_attestation": on_attestation,
         "beacon_aggregate_and_proof": on_aggregate,
         "sync_committee": on_sync_message,

@@ -40,6 +40,11 @@ class ReqRespBeaconNode(ReqResp):
             quota=RateLimiterQuota(128, 10.0),
         )
         self.register_handler(_pid("goodbye"), self._on_goodbye)
+        self.register_handler(
+            _pid("blobs_sidecars_by_range"),
+            self._on_blobs_by_range,
+            quota=RateLimiterQuota(128, 10.0),
+        )
         # light-client protocols (reference reqresp/protocols.ts
         # LightClientBootstrap/UpdatesByRange/FinalityUpdate/OptimisticUpdate)
         self.register_handler(
@@ -116,6 +121,32 @@ class ReqRespBeaconNode(ReqResp):
 
     async def _on_goodbye(self, req, peer):
         yield 0  # GoodbyeReason: client shutdown acknowledgment
+
+    async def _on_blobs_by_range(self, req, peer):
+        """Coupled sidecars for the canonical chain slice (reference
+        BlobsSidecarsByRange): resolve each slot's canonical block root
+        (hot walk falling back to the archive root index), then the
+        root-keyed sidecar store."""
+        count = min(int(req.count), 128)
+        lo, hi = int(req.start_slot), int(req.start_slot) + count
+        fc = self.chain.fork_choice.proto_array
+        node = fc.get_block(self.chain.fork_choice.head)
+        roots_by_slot = {}
+        while node is not None and node.slot >= lo:
+            if node.slot < hi:
+                roots_by_slot[node.slot] = bytes.fromhex(node.block_root[2:])
+            node = fc.nodes[node.parent] if node.parent is not None else None
+        for slot in range(lo, hi):
+            root = roots_by_slot.get(slot)
+            if root is None:
+                signed = self.chain.archiver.get_archived_block_by_slot(slot)
+                if signed is None:
+                    continue
+                ns = getattr(self.chain.types, self.chain.fork_name_at_slot(slot))
+                root = ns.BeaconBlock.hash_tree_root(signed.message)
+            sidecar = self.chain.get_blobs_sidecar(root)
+            if sidecar is not None:
+                yield sidecar
 
     # -- light-client protocols ------------------------------------------------
 

@@ -52,6 +52,12 @@ BEACON_PROTOCOLS: dict[str, Protocol] = {
             lambda: _t().phase0.SignedBeaconBlock,
             1024,
         ),
+        Protocol(
+            _pid("blobs_sidecars_by_range"),
+            lambda: _t().deneb.BlobsSidecarsByRangeRequest,
+            lambda: _t().deneb.BlobsSidecar,
+            128,
+        ),
         # light-client protocols (reference protocols.ts LightClient*)
         Protocol(
             _pid("light_client_bootstrap"),

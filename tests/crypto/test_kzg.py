@@ -67,3 +67,47 @@ def test_constant_blob_commitment_and_proof(setup):
     assert verify_blob_kzg_proof(blob, commitment, G1_INF)
     wrong = g1_to_bytes(C.g1_mul(C.G1_GEN, c + 1))
     assert not verify_blob_kzg_proof(blob, wrong, G1_INF)
+
+
+def test_aggregate_kzg_proof_roundtrip_and_tamper():
+    """Early-4844 coupled-sidecar crypto: compute_aggregate_kzg_proof
+    over full-size blobs verifies, and any swap/tamper fails."""
+    import hashlib as _hashlib
+
+    from lodestar_tpu.crypto import kzg as K
+
+    def blob_of(seed):
+        out = b""
+        for i in range(K.FIELD_ELEMENTS_PER_BLOB_MAINNET):
+            h = int.from_bytes(
+                _hashlib.sha256(bytes([seed]) + i.to_bytes(4, "big")).digest(), "big"
+            ) % K.R
+            out += h.to_bytes(32, "big")
+        return out
+
+    b1, b2 = blob_of(9), blob_of(10)
+    c1 = K.blob_to_kzg_commitment(b1, device=False)
+    c2 = K.blob_to_kzg_commitment(b2, device=False)
+    proof = K.compute_aggregate_kzg_proof([b1, b2], device=False)
+    assert K.verify_aggregate_kzg_proof([b1, b2], [c1, c2], proof)
+    assert not K.verify_aggregate_kzg_proof([b1, b2], [c2, c1], proof)
+    assert not K.verify_aggregate_kzg_proof([b2, b1], [c1, c2], proof)
+    # empty sidecar: infinity proof and only that
+    assert K.verify_aggregate_kzg_proof([], [], K.G1_INFINITY_BYTES)
+    assert not K.verify_aggregate_kzg_proof([], [], proof)
+    # validate_blobs_sidecar end-to-end via a fake sidecar object
+    class _S:
+        beacon_block_slot = 7
+        beacon_block_root = b"\x11" * 32
+        blobs = [b1, b2]
+        kzg_aggregated_proof = proof
+
+    K.validate_blobs_sidecar(7, b"\x11" * 32, [c1, c2], _S())
+    import pytest as _pytest
+
+    with _pytest.raises(K.KzgError, match="slot"):
+        K.validate_blobs_sidecar(8, b"\x11" * 32, [c1, c2], _S())
+    with _pytest.raises(K.KzgError, match="proof"):
+        class _Bad(_S):
+            kzg_aggregated_proof = K.G1_INFINITY_BYTES
+        K.validate_blobs_sidecar(7, b"\x11" * 32, [c1, c2], _Bad())
