@@ -15,12 +15,12 @@ Representation (tpu-first):
   whole field stack runs on the VPU with no emulated 64-bit arithmetic.
 * Elements live in Montgomery form (R = 2^384) between `to_mont` /
   `from_mont`. Multiplication is a polynomial (convolution) product
-  expressed as one batched matmul against a constant one-hot band tensor
-  (XLA maps it to efficient fused multiply-adds), followed by a 32-step
-  Montgomery reduction statically unrolled into fused elementwise ops
-  (see `_mont_reduce` for why a loop op is ruinous here) — sequential in
-  limbs, fully parallel across the batch, which is where the throughput
-  lives.
+  built from 32 shifted fused multiply-adds, followed by a SEPARATED
+  Montgomery reduction (m = t_lo * P' mod R in one triangular conv, then
+  (t + m*p)/R) whose carries resolve in three data-parallel passes — no
+  per-limb sequential loop anywhere in the multiply (see `_mont_redc`).
+  Sequential work per multiply is one exact carry scan + one conditional
+  subtract for the canonical-output contract.
 * All public ops are shape-polymorphic over leading batch dims and safe
   under jit/vmap/shard_map.
 """
@@ -82,6 +82,14 @@ def limbs_from_ints(xs) -> np.ndarray:
     return np.stack([limbs_from_int(x) for x in xs])
 
 
+def mont_limbs_from_int(x: int) -> np.ndarray:
+    """Host-side (pure numpy) Montgomery-form limbs of x: mont(x) is just
+    x * 2^384 mod p. The ONE sanctioned way to build mont-form module
+    constants — importing callers must never run the jitted `to_mont`
+    (import-time device compute was the r3 multichip-gate regression)."""
+    return limbs_from_int(x * (1 << (LIMBS * LIMB_BITS)) % P)
+
+
 def ints_from_limbs(arr) -> list[int]:
     a = np.asarray(arr)
     return [int_from_limbs(a[i]) for i in range(a.shape[0])]
@@ -92,18 +100,14 @@ def ints_from_limbs(arr) -> list[int]:
 P_LIMBS = limbs_from_int(P)
 R_MOD_P = (1 << (LIMBS * LIMB_BITS)) % P  # 2^384 mod p (the Montgomery "1")
 R2_MOD_P = pow(1 << (LIMBS * LIMB_BITS), 2, P)
-# -p^{-1} mod 2^12 (per-limb Montgomery factor)
-PPRIME = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
-
 ONE_MONT_LIMBS = limbs_from_int(R_MOD_P)
 R2_LIMBS = limbs_from_int(R2_MOD_P)
 
-# One-hot band tensor mapping the 32x32 outer product onto the 63 (padded
-# to 64) coefficients of the polynomial product: T[i*32+j, i+j] = 1.
-_T = np.zeros((LIMBS * LIMBS, 2 * LIMBS), dtype=np.int32)
-for _i in range(LIMBS):
-    for _j in range(LIMBS):
-        _T[_i * LIMBS + _j, _i + _j] = 1
+# Full-width Montgomery factor P' = -P^{-1} mod 2^384 (the separated
+# Montgomery reduction computes m = t_lo * P' mod R in one shot instead of
+# 32 per-limb sequential steps — see _mont_redc).
+PPRIME_FULL = (-pow(P, -1, 1 << (LIMBS * LIMB_BITS))) % (1 << (LIMBS * LIMB_BITS))
+PPRIME_LIMBS = limbs_from_int(PPRIME_FULL)
 
 
 def zero(batch_shape=()) -> jax.Array:
@@ -197,53 +201,115 @@ def neg(a):
     return jnp.where(nz, _cond_sub_p(_carry_full(jnp.asarray(P_LIMBS) - a, passes=2)), a)
 
 
-def _mont_reduce(t):
-    """Montgomery reduction of a (.., 64) product accumulator -> (.., 32).
+def _conv_pair(a, b):
+    """Polynomial product (.., 32) x (.., 32) -> (.., 64) as 32 shifted
+    fused multiply-adds.
 
-    t limbs are < 2^30 coming in; each of the 32 steps clears one low limb
-    (adding m*p keeps limbs < 2^30 + 2^24*1 per step, bounded < 2^31).
+    This replaces the original outer-product + one-hot band-tensor matmul,
+    which materialized a (.., 32, 32) int32 accumulator and burned 64
+    redundant MACs per useful one — measured on the chip as the dominant
+    HBM traffic of the whole pairing. Here every term is an elementwise
+    mul + zero-pad that XLA fuses into a single kernel: the only arrays
+    that exist are the inputs and the (.., 64) output.
 
-    Kept as a `fori_loop` (unroll=4) deliberately: a fully static unroll
-    was measured on the real chip at IDENTICAL runtime (the program is
-    latency-bound elsewhere) while tripling XLA compile time, so the
-    rolled form wins on compile cost with nothing given up.
+    Coefficients <= 32 * (2^12-1)^2 < 2^29 (int32-safe).
     """
-    p_limbs = jnp.asarray(P_LIMBS)
+    pad_head = [(0, 0)] * (a.ndim - 1)
+    total = None
+    for j in range(LIMBS):
+        term = jnp.pad(a * b[..., j : j + 1], pad_head + [(j, LIMBS - j)])
+        total = term if total is None else total + term
+    return total
 
-    def body(i, t):
-        ci = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
-        m = ((ci & LIMB_MASK) * PPRIME) & LIMB_MASK
-        # t[i : i+32] += m * p
-        window = jax.lax.dynamic_slice_in_dim(t, i, LIMBS, axis=-1)
-        window = window + m[..., None] * p_limbs
-        t = jax.lax.dynamic_update_slice_in_dim(t, window, i, axis=-1)
-        # low limb of t[i] is now 0 mod 2^12; push its carry into t[i+1]
-        ci2 = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
-        carry = ci2 >> LIMB_BITS
-        nxt = jax.lax.dynamic_index_in_dim(t, i + 1, axis=-1, keepdims=False) + carry
-        t = jax.lax.dynamic_update_index_in_dim(t, nxt, i + 1, axis=-1)
-        return t
 
-    t = jax.lax.fori_loop(0, LIMBS, body, t, unroll=4)
-    hi = t[..., LIMBS:]
-    return _cond_sub_p(_carry_full(hi, passes=4))
+def _conv_sq(a):
+    """Polynomial square (.., 32) -> (.., 64): the j-th shifted row starts
+    at its diagonal term a_j^2 (counted once) followed by the doubled
+    cross terms 2*a_i*a_j for i > j — ~half the multiplies of _conv_pair.
+    Same < 2^29 coefficient bound (the double counts ordered pairs)."""
+    pad_head = [(0, 0)] * (a.ndim - 1)
+    total = None
+    for j in range(LIMBS):
+        row = a[..., j:] * a[..., j : j + 1]
+        row = jnp.concatenate([row[..., :1], row[..., 1:] + row[..., 1:]], axis=-1)
+        term = jnp.pad(row, pad_head + [(2 * j, LIMBS - j)])
+        total = term if total is None else total + term
+    return total
+
+
+def _conv_const_low(x, climbs) -> jax.Array:
+    """First 32 coefficients of x * const (triangular conv, i.e. the
+    product mod 2^384). climbs: host numpy 12-bit limbs; zero limbs cost
+    nothing. x limbs <= 2^12 -> coefficients < 2^29."""
+    pad_head = [(0, 0)] * (x.ndim - 1)
+    total = None
+    for j, cj in enumerate(int(v) for v in climbs):
+        if cj == 0:
+            continue
+        term = jnp.pad(x[..., : LIMBS - j] * cj, pad_head + [(j, 0)])
+        total = term if total is None else total + term
+    return total
+
+
+def _conv_const_full(x, climbs) -> jax.Array:
+    """Full product x * const as (.., 64) coefficients. x limbs <= 2^12 ->
+    coefficients < 2^29."""
+    pad_head = [(0, 0)] * (x.ndim - 1)
+    total = None
+    for j, cj in enumerate(int(v) for v in climbs):
+        if cj == 0:
+            continue
+        term = jnp.pad(x * cj, pad_head + [(j, LIMBS - j)])
+        total = term if total is None else total + term
+    return total
+
+
+def _carry3(x):
+    """Three parallel carry passes: limbs < 2^30 in -> limbs <= 2^12
+    ("loose-clean": 2^12 itself is reachable via carry ripple) with value
+    preserved (the carry out of the top limb is dropped — callers
+    guarantee it is zero for 64-wide inputs and rely on the mod-2^384
+    semantics for 32-wide ones). Carry magnitudes shrink 2^12 per pass:
+    2^17 -> 2^5 -> 1."""
+    return _carry_once(_carry_once(_carry_once(x)))
+
+
+def _mont_redc(t):
+    """Separated Montgomery reduction: (.., 64) accumulator with limbs
+    <= 2^12 (loose-clean) -> canonical (.., 32) t * R^{-1} mod p.
+
+    Classic two-multiplication form (m = t_lo * P' mod R; result =
+    (t + m*p) / R), with every step a data-parallel conv/carry — the
+    original per-limb interleaved reduction serialized 32 heavyweight
+    steps (dynamic 32-wide slice updates) per multiply.
+
+    The division by R needs the carry out of the low half. After _carry3
+    the low half's limbs are <= 2^12, so its value is < 1.0003 * 2^384;
+    since it is a multiple of 2^384 by construction, it is EXACTLY 0 or
+    2^384 — the carry is just the batch predicate any(s_lo != 0). No
+    sequential scan anywhere in the reduction.
+    """
+    m = _carry3(_conv_const_low(t[..., :LIMBS], PPRIME_LIMBS))  # mod 2^384
+    s = _carry3(t + _conv_const_full(m, P_LIMBS))
+    carry = jnp.any(s[..., :LIMBS] != 0, axis=-1)
+    hi = s[..., LIMBS:]
+    hi0 = hi[..., :1] + carry[..., None].astype(jnp.int32)
+    hi = jnp.concatenate([hi0, hi[..., 1:]], axis=-1)  # limbs <= 2^12 + 1
+    # result value < 1.11 p (p^2/R + 1.0003 p): one exact normalize + one
+    # conditional subtract restores the canonical contract.
+    return _cond_sub_p(_carry_seq(hi))
 
 
 @jax.jit
 def mont_mul(a, b):
-    """Montgomery product abR^{-1} mod p; canonical in/out.
-
-    The schoolbook product is one batched matmul against the constant band
-    tensor: outer(a,b).reshape(B, 1024) @ T(1024, 64).
-    """
-    outer = a[..., :, None] * b[..., None, :]
-    flat = outer.reshape(*outer.shape[:-2], LIMBS * LIMBS)
-    t = flat @ jnp.asarray(_T)
-    return _mont_reduce(t)
+    """Montgomery product abR^{-1} mod p; canonical in/out."""
+    return _mont_redc(_carry3(_conv_pair(a, b)))
 
 
+@jax.jit
 def mont_sq(a):
-    return mont_mul(a, a)
+    """Montgomery square — dedicated halved-conv path (see _conv_sq)."""
+    return _mont_redc(_carry3(_conv_sq(a)))
 
 
 @jax.jit
@@ -254,9 +320,10 @@ def to_mont(a):
 
 @jax.jit
 def from_mont(a):
-    """Montgomery -> standard form (a * R^{-1} mod p) via reduction of a."""
+    """Montgomery -> standard form (a * R^{-1} mod p) via reduction of a.
+    Canonical input limbs are already clean: no pre-carry needed."""
     t = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, LIMBS)])
-    return _mont_reduce(t)
+    return _mont_redc(t)
 
 
 def _exp_bits(e: int) -> np.ndarray:

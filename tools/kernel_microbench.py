@@ -1,0 +1,159 @@
+"""Microbenchmark fp-kernel variants on the real device.
+
+Measures, at the batch-verify operating shape (~221k field elements),
+the per-call time of:
+  * the live mont_mul / add / carry primitives
+  * alternative conv formulations (band-matmul, stacked-pad sum)
+  * a scan-free "lazy" mont_mul prototype (no exact carry, no cond-sub)
+Prints one line per variant: name, ms/call, implied GB/s of array traffic.
+
+Run: python tools/kernel_microbench.py [batch]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.ops import fp
+from lodestar_tpu.utils import enable_compile_cache
+
+enable_compile_cache(".")
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096 * 54
+
+rng = np.random.default_rng(0)
+
+
+def rand_fp(n):
+    vals = [int.from_bytes(rng.bytes(47), "big") % fp.P for _ in range(n)]
+    return jnp.asarray(fp.limbs_from_ints(vals))
+
+
+a = rand_fp(B)
+b = rand_fp(B)
+
+
+def timeit(name, fn, *args, iters=10, passes_bytes=None):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    gbps = (passes_bytes / dt / 1e9) if passes_bytes else 0.0
+    print(f"{name:34s} {dt*1e3:9.3f} ms   {gbps:7.1f} GB/s(min-traffic)", flush=True)
+    return dt
+
+
+ARR = B * 32 * 4  # one (B, 32) int32 pass
+
+
+# --- live primitives ---------------------------------------------------------
+
+timeit("mont_mul (live)", fp.mont_mul, a, b, passes_bytes=3 * ARR)
+timeit("mont_sq (live)", fp.mont_sq, a, passes_bytes=2 * ARR)
+timeit("add (live)", fp.add, a, b, passes_bytes=3 * ARR)
+
+
+@jax.jit
+def carry_seq_only(x):
+    return fp._carry_seq(x)
+
+
+@jax.jit
+def cond_sub_only(x):
+    return fp._cond_sub_p(x)
+
+
+@jax.jit
+def carry3_only(x):
+    return fp._carry3(jnp.pad(x, [(0, 0), (0, fp.LIMBS)]))
+
+
+timeit("_carry_seq alone", carry_seq_only, a, passes_bytes=2 * ARR)
+timeit("_cond_sub_p alone", cond_sub_only, a, passes_bytes=2 * ARR)
+timeit("_carry3 (64-wide) alone", carry3_only, a, passes_bytes=4 * ARR)
+
+
+# --- conv variants -----------------------------------------------------------
+
+
+@jax.jit
+def conv_shift(a, b):
+    return fp._conv_pair(a, b)
+
+
+_T = np.zeros((fp.LIMBS * fp.LIMBS, 2 * fp.LIMBS), dtype=np.int32)
+for i in range(fp.LIMBS):
+    for j in range(fp.LIMBS):
+        _T[i * fp.LIMBS + j, i + j] = 1
+
+
+@jax.jit
+def conv_bandmatmul(a, b):
+    outer = a[..., :, None] * b[..., None, :]
+    flat = outer.reshape(*outer.shape[:-2], fp.LIMBS * fp.LIMBS)
+    return flat @ jnp.asarray(_T)
+
+
+@jax.jit
+def conv_stacksum(a, b):
+    terms = [
+        jnp.pad(a * b[..., j : j + 1], [(0, 0), (j, fp.LIMBS - j)])
+        for j in range(fp.LIMBS)
+    ]
+    return jnp.sum(jnp.stack(terms, 0), 0)
+
+
+timeit("conv: shifted-FMA chain (live)", conv_shift, a, b, passes_bytes=4 * ARR)
+timeit("conv: outer+band matmul (old)", conv_bandmatmul, a, b, passes_bytes=4 * ARR)
+timeit("conv: stack+sum", conv_stacksum, a, b, passes_bytes=4 * ARR)
+
+
+# --- lazy mont_mul prototype (no scans, no cond-sub) -------------------------
+
+
+@jax.jit
+def mont_mul_lazy(a, b):
+    t = fp._carry_once(fp._carry_once(fp._conv_pair(a, b)))
+    m = fp._carry_once(fp._carry_once(fp._conv_const_low(t[..., : fp.LIMBS], fp.PPRIME_LIMBS)))
+    s = fp._carry_once(fp._carry_once(t + fp._conv_const_full(m, fp.P_LIMBS)))
+    carry = jnp.any(s[..., : fp.LIMBS] != 0, axis=-1)
+    hi = s[..., fp.LIMBS :]
+    hi0 = hi[..., :1] + carry[..., None].astype(jnp.int32)
+    return jnp.concatenate([hi0, hi[..., 1:]], axis=-1)
+
+
+timeit("mont_mul LAZY prototype", mont_mul_lazy, a, b, passes_bytes=3 * ARR)
+
+
+# --- chained composition (amortization check) --------------------------------
+
+
+@jax.jit
+def chain8_live(a, b):
+    x = a
+    for _ in range(8):
+        x = fp.mont_mul(x, b)
+    return x
+
+
+@jax.jit
+def chain8_lazy(a, b):
+    x = a
+    for _ in range(8):
+        x = mont_mul_lazy(x, b)
+    return x
+
+
+timeit("8-chain live mont_mul", chain8_live, a, b, iters=5, passes_bytes=24 * ARR)
+timeit("8-chain LAZY mont_mul", chain8_lazy, a, b, iters=5, passes_bytes=24 * ARR)
+
+print("done", flush=True)
