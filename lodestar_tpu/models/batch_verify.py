@@ -76,9 +76,11 @@ def _g2_batch_host(pts) -> tuple[np.ndarray, np.ndarray]:
     return np.asarray(xs), np.asarray(ys)
 
 
-# device-constant: -g1 generator, mont form (computed once at import)
-_NEG_G1_X = _fp_to_mont_host([G1_GEN[0]])[0]
-_NEG_G1_Y = _fp_to_mont_host([(-G1_GEN[1]) % C.P])[0]
+# device-constant: -g1 generator, mont form. Pure numpy — import of this
+# module must never touch a JAX backend (the r3 multichip gate
+# regression class).
+_NEG_G1_X = fp.mont_limbs_from_int(G1_GEN[0])
+_NEG_G1_Y = fp.mont_limbs_from_int((-G1_GEN[1]) % C.P)
 
 
 def _bits_msb(scalars: np.ndarray, width: int) -> np.ndarray:

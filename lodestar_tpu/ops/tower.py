@@ -298,14 +298,24 @@ def fp12_eq_one(a):
 
 
 # Frobenius coefficients g_i(k) = xi^(i*(p^k-1)/6) for powers k=1..3,
-# derived through the oracle (runtime-computed, mont-form device constants).
+# derived through the oracle. Computed in PURE PYTHON via
+# fp.mont_limbs_from_int — no JAX at import time, so importing this
+# module never initializes a device backend (the r3 multichip dryrun
+# failed precisely because fp2_from_ints -> fp.to_mont ran jitted JAX
+# here and woke the default TPU backend before the dryrun picked its CPU
+# mesh).
+
+
+def _fp2_mont_limbs_host(c0: int, c1: int) -> np.ndarray:
+    """(c0, c1) ints -> (2, 32) mont-form limbs, numpy only."""
+    return np.stack([fp.mont_limbs_from_int(c0), fp.mont_limbs_from_int(c1)])
+
+
 _FROB_K = {}
 for _k in (1, 2, 3):
     _FROB_K[_k] = np.stack(
         [
-            np.asarray(
-                fp2_from_ints([F.fp2_pow(F.XI, _i * (F.P**_k - 1) // 6)])[0]
-            )
+            _fp2_mont_limbs_host(*F.fp2_pow(F.XI, _i * (F.P**_k - 1) // 6))
             for _i in range(6)
         ]
     )

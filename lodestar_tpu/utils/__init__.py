@@ -41,8 +41,39 @@ def enable_compile_cache(repo_root: str | None = None) -> None:
 
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    jax.config.update("jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    # Key the cache by a host fingerprint: XLA:CPU AOT entries embed the
+    # compile machine's feature set and loading one compiled elsewhere can
+    # SIGILL (observed as cpu_aot_loader machine-feature mismatch spew in
+    # the r3 multichip gate). A fingerprint subdir turns "stale cache from
+    # another machine/jax" into a clean cache miss.
+    import hashlib
+    import platform
+
+    cpu_flags = b""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    cpu_flags = " ".join(sorted(line.split(":", 1)[1].split())).encode()
+                    break
+    except OSError:
+        pass
+    fp = hashlib.sha1(
+        b"|".join([platform.machine().encode(), jax.__version__.encode(), cpu_flags])
+    ).hexdigest()[:12]
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache", fp)
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        # A stale/corrupt cache entry (e.g. written by a different libtpu or
+        # machine feature set) must degrade to a cache MISS, never kill the
+        # process — r3's multichip gate died partly on fragile AOT cache
+        # deserialization.
+        jax.config.update("jax_raise_persistent_cache_errors", False)
+    except Exception:
+        # unknown flag on this jax version / unwritable dir: run uncached
+        pass
 
 
 class ErrorAborted(Exception):
