@@ -128,10 +128,21 @@ class RestServer:
     `dispatch(method, path, query, body)` (the Beacon API and the
     validator keymanager API share this host)."""
 
-    def __init__(self, router, *, host: str = "127.0.0.1", port: int = 9596):
+    def __init__(
+        self,
+        router,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 9596,
+        auth_token: str | None = None,
+    ):
         self.router = router
         self.host = host
         self.port = port
+        # When set, every request must carry `Authorization: Bearer <token>`
+        # (the keymanager API's api-token.txt scheme, reference
+        # `keymanager/server/index.ts` bearer auth).
+        self.auth_token = auth_token
         self._httpd = None
         self._thread: threading.Thread | None = None
         self._sse_streams: set = set()  # live EventStreams, closed on stop()
@@ -148,6 +159,21 @@ class RestServer:
             def _run(self, method):
                 parts = urlsplit(self.path)
                 query = dict(parse_qsl(parts.query))
+                if outer.auth_token is not None:
+                    import hmac
+
+                    # compare as bytes: compare_digest raises TypeError on
+                    # non-ASCII str (headers arrive latin-1 decoded)
+                    presented = (self.headers.get("Authorization") or "").encode(
+                        "utf-8", "surrogateescape"
+                    )
+                    expected = f"Bearer {outer.auth_token}".encode()
+                    if not hmac.compare_digest(presented, expected):
+                        payload = json.dumps(
+                            {"code": 401, "message": "missing or invalid bearer token"}
+                        ).encode()
+                        self._reply(401, payload)
+                        return
                 try:
                     body = None
                     if method in ("POST", "DELETE"):

@@ -50,6 +50,13 @@ __all__ = [
 class SecretKey:
     scalar: int
 
+    def __post_init__(self):
+        # same range contract as from_bytes — the direct constructor must
+        # not mint the identity-key footgun (sk=0 signs everything with
+        # the infinity signature)
+        if not 0 < self.scalar < R:
+            raise ValueError("secret key out of range (must satisfy 0 < SK < r)")
+
     @classmethod
     def from_bytes(cls, data: bytes) -> "SecretKey":
         """Strict IETF deserialization: 32 bytes, 0 < SK < r (no reduction)."""

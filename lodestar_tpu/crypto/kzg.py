@@ -43,7 +43,13 @@ FIELD_ELEMENTS_PER_BLOB_MAINNET = 4096
 _SETUP_PATH = os.path.join(os.path.dirname(__file__), "trusted_setup.bin")
 _GENERATOR = 7  # Fr multiplicative generator (c-kzg GENERATOR)
 BYTES_PER_FIELD_ELEMENT = 32
-FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVH"
+# Early-4844 wire convention, pinned by the reference's `c-kzg: ^1.0.9`
+# (`packages/beacon-node/package.json:136`): 16-byte domain string,
+# field-element bytes LITTLE-endian. (The final mainnet-deneb spec later
+# switched to big-endian; v1.8.0's coupled BlobsSidecar flow predates
+# that.)
+FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVERIFY_V1_"
+KZG_ENDIANNESS = "little"
 
 
 class KzgError(Exception):
@@ -107,7 +113,7 @@ def _blob_to_scalars(blob: bytes) -> list[int]:
         raise KzgError("blob length not a multiple of 32")
     out = []
     for i in range(0, len(blob), BYTES_PER_FIELD_ELEMENT):
-        v = int.from_bytes(blob[i : i + 32], "big")
+        v = int.from_bytes(blob[i : i + 32], KZG_ENDIANNESS)
         if v >= R:
             raise KzgError("blob element out of field range")
         out.append(v)
@@ -244,13 +250,21 @@ def _evaluate_blob_at(blob_scalars: list[int], z: int) -> int:
     return total * zn % R * pow(n, R - 2, R) % R
 
 
+def _hash_to_bls_field(data: bytes) -> int:
+    """Spec hash_to_bls_field: sha256 reduced to Fr, module endianness."""
+    return int.from_bytes(hashlib.sha256(data).digest(), KZG_ENDIANNESS) % R
+
+
 def _compute_challenge(blob: bytes, commitment: bytes) -> int:
-    """Fiat-Shamir challenge (spec compute_challenge)."""
+    """Fiat-Shamir challenge for the per-blob (decoupled, later-deneb API)
+    proof: domain || uint128(FIELD_ELEMENTS_PER_BLOB) || blob ||
+    commitment hashed to a field element. Endianness follows the module's
+    early-4844 convention."""
     n = len(blob) // BYTES_PER_FIELD_ELEMENT
-    # spec compute_challenge: domain || uint128be(FIELD_ELEMENTS_PER_BLOB)
-    # || blob || commitment, hashed to a field element big-endian
-    data = FIAT_SHAMIR_PROTOCOL_DOMAIN + n.to_bytes(16, "big") + blob + commitment
-    return int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+    data = (
+        FIAT_SHAMIR_PROTOCOL_DOMAIN + n.to_bytes(16, KZG_ENDIANNESS) + blob + commitment
+    )
+    return _hash_to_bls_field(data)
 
 
 def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes) -> bool:
@@ -283,23 +297,45 @@ def _commit_evals(scalars: list[int], device: bool) -> bytes:
     return _commit_msm(g1, _inverse_ntt(evals_natural), device)
 
 
-def _hash_to_field(data: bytes) -> int:
-    return int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+def _compute_challenges(blobs: list[bytes], commitments: list[bytes]) -> tuple[int, int]:
+    """(folding challenge r, evaluation challenge x) for the aggregate
+    proof, mirroring c-kzg 1.0.x `compute_challenges` (eip4844.c — the
+    implementation the reference links, `package.json:136` c-kzg ^1.0.9):
+
+        transcript = domain(16B) || uint64le(FIELD_ELEMENTS_PER_BLOB)
+                   || uint64le(n) || blob bytes || commitment bytes
+        hashed    = sha256(transcript)
+        r         = hash_to_bls_field(sha256(hashed || 0x00))
+        x         = hash_to_bls_field(sha256(hashed || 0x01))
+
+    Both challenges squeeze from ONE transcript over the raw wire bytes;
+    in particular x does NOT depend on the aggregated commitment. Field
+    elements reduce little-endian (KZG_ENDIANNESS). Reconstructed from
+    the c-kzg source of that era — the official vectors are unreachable
+    from this build environment, so byte-for-byte interop is asserted by
+    construction, not fixtures.
+    """
+    n = len(blobs)
+    width = len(blobs[0]) // BYTES_PER_FIELD_ELEMENT
+    h = hashlib.sha256()
+    h.update(FIAT_SHAMIR_PROTOCOL_DOMAIN)
+    h.update(width.to_bytes(8, KZG_ENDIANNESS))
+    h.update(n.to_bytes(8, KZG_ENDIANNESS))
+    for b in blobs:
+        h.update(bytes(b))
+    for c in commitments:
+        h.update(bytes(c))
+    hashed = h.digest()
+    r = _hash_to_bls_field(hashed + b"\x00")
+    x = _hash_to_bls_field(hashed + b"\x01")
+    return r, x
 
 
-def _aggregate(blob_scalar_lists: list[list[int]], commitments: list[bytes]):
+def _aggregate(blob_scalar_lists: list[list[int]], commitments: list[bytes], r: int):
     """(aggregated eval-form scalars, aggregated commitment point) via
     powers of the folding challenge r (early spec
     compute_aggregated_poly_and_commitment)."""
     n = len(blob_scalar_lists)
-    h = hashlib.sha256()
-    h.update(FIAT_SHAMIR_PROTOCOL_DOMAIN + n.to_bytes(16, "big"))
-    for scalars in blob_scalar_lists:
-        for s in scalars:
-            h.update(s.to_bytes(32, "big"))
-    for c in commitments:
-        h.update(bytes(c))
-    r = int.from_bytes(h.digest(), "big") % R
     powers = [pow(r, i, R) for i in range(n)]
     width = len(blob_scalar_lists[0])
     agg = [0] * width
@@ -316,16 +352,7 @@ def _aggregate(blob_scalar_lists: list[list[int]], commitments: list[bytes]):
             raise KzgError("commitment outside the G1 subgroup")
         if pt is not None and coeff:
             agg_commitment = C.g1_add(agg_commitment, C.g1_mul(pt, coeff))
-    return agg, agg_commitment, r
-
-
-def _opening_challenge(agg_scalars: list[int], agg_commitment_bytes: bytes) -> int:
-    h = hashlib.sha256()
-    h.update(FIAT_SHAMIR_PROTOCOL_DOMAIN + b"\x01")
-    for s in agg_scalars:
-        h.update(s.to_bytes(32, "big"))
-    h.update(agg_commitment_bytes)
-    return int.from_bytes(h.digest(), "big") % R
+    return agg, agg_commitment
 
 
 def compute_aggregate_kzg_proof(blobs: list[bytes], *, device: bool = True) -> bytes:
@@ -335,8 +362,8 @@ def compute_aggregate_kzg_proof(blobs: list[bytes], *, device: bool = True) -> b
         return G1_INFINITY_BYTES
     blob_scalars = [_blob_to_scalars(b) for b in blobs]
     commitments = [blob_to_kzg_commitment(b, device=device) for b in blobs]
-    agg, agg_pt, _r = _aggregate(blob_scalars, commitments)
-    x = _opening_challenge(agg, g1_to_bytes(agg_pt))
+    r, x = _compute_challenges([bytes(b) for b in blobs], commitments)
+    agg, _agg_pt = _aggregate(blob_scalars, commitments, r)
     y = _evaluate_blob_at(agg, x)
     # quotient in evaluation form: q_i = (p_i - y) / (w_i - x)
     roots = compute_roots_of_unity(len(agg))
@@ -358,8 +385,9 @@ def verify_aggregate_kzg_proof(
         return bytes(proof) == G1_INFINITY_BYTES
     try:
         blob_scalars = [_blob_to_scalars(b) for b in blobs]
-        agg, agg_pt, _r = _aggregate(blob_scalars, [bytes(c) for c in commitments])
-        x = _opening_challenge(agg, g1_to_bytes(agg_pt))
+        commitment_bytes = [bytes(c) for c in commitments]
+        r, x = _compute_challenges([bytes(b) for b in blobs], commitment_bytes)
+        agg, agg_pt = _aggregate(blob_scalars, commitment_bytes, r)
         y = _evaluate_blob_at(agg, x)
         return verify_kzg_proof(g1_to_bytes(agg_pt), x, y, bytes(proof))
     except (KzgError, PointDecodeError):

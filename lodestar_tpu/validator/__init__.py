@@ -60,12 +60,21 @@ class Validator:
             from lodestar_tpu.chain.produce_block import produce_block
 
             epoch = slot // self.p.SLOTS_PER_EPOCH
+            # only the store.sign_* calls may raise ValueError for
+            # concurrent key removal — produce_block stays OUTSIDE the
+            # guard so real production bugs surface instead of silently
+            # skipping the proposal
+            signed = None
             try:
                 reveal = self.store.sign_randao(proposer_pk, epoch)
-                block = produce_block(self.chain, slot=slot, randao_reveal=reveal)
-                signed = self.store.sign_block(proposer_pk, block)
             except ValueError:
-                signed = None  # key removed concurrently by the keymanager
+                reveal = None  # key removed concurrently by the keymanager
+            if reveal is not None:
+                block = produce_block(self.chain, slot=slot, randao_reveal=reveal)
+                try:
+                    signed = self.store.sign_block(proposer_pk, block)
+                except ValueError:
+                    signed = None  # key removed concurrently
             if signed is not None:
                 await self.chain.process_block(signed, is_timely=True)
                 out["proposed"] = signed

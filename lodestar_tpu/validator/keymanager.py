@@ -279,9 +279,37 @@ class KeymanagerRouter:
         return 204
 
 
-def create_keymanager_server(km: KeymanagerApi, *, host: str = "127.0.0.1", port: int = 0):
+def create_keymanager_server(
+    km: KeymanagerApi,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    token_dir: str | None = None,
+):
     """RestServer hosting the keymanager routes (reference runs this on
-    the validator process, `keymanager/server/index.ts`)."""
+    the validator process, `keymanager/server/index.ts`).
+
+    A bearer token is generated on startup and REQUIRED on every route
+    (`Authorization: Bearer ...`) — key import/delete, interchange export
+    and fee-recipient redirection must not be reachable by any co-resident
+    process that can open the port. The token is exposed as
+    `server.auth_token` and, when `token_dir` is given, written to
+    `api-token.txt` in the standard format.
+    """
+    import secrets
+
     from lodestar_tpu.api.server import RestServer
 
-    return RestServer(KeymanagerRouter(km), host=host, port=port)
+    token = "api-token-0x" + secrets.token_hex(32)
+    if token_dir is not None:
+        import os
+
+        os.makedirs(token_dir, exist_ok=True)
+        path = os.path.join(token_dir, "api-token.txt")
+        with open(path, "w") as f:
+            f.write(token + "\n")
+        try:
+            os.chmod(path, 0o600)
+        except OSError:
+            pass
+    return RestServer(KeymanagerRouter(km), host=host, port=port, auth_token=token)

@@ -16,6 +16,7 @@ from lodestar_tpu.params import BeaconPreset, active_preset
 from lodestar_tpu.ssz.json import from_json, to_json
 from lodestar_tpu.types import ssz_types
 
+from .slashing_protection import SlashingError
 from .store import ValidatorStore
 
 __all__ = ["RestValidator"]
@@ -106,11 +107,24 @@ class RestValidator:
             pk = self._index_to_pubkey.get(vi)
             if pk is None or not self._may_sign(pk):
                 continue
+            # per-duty isolation: one key's slashing refusal or concurrent
+            # keymanager removal must not drop the other keys'
+            # already-signed attestations for the slot (mirrors the
+            # in-process Validator's per-duty guards). Only the SIGN call
+            # is guarded — a malformed beacon response (from_json
+            # ValueError) is a real bug and must surface, not be
+            # misreported as a skipped duty.
             data_json = self.client.produce_attestation_data(
                 slot, int(duty["committee_index"])
             )["data"]
             data = from_json(t.AttestationData, data_json)
-            sig = self.store.sign_attestation(pk, data)
+            try:
+                sig = self.store.sign_attestation(pk, data)
+            except (SlashingError, ValueError) as e:
+                self.log.warning(
+                    "attestation duty skipped validator=%d: %s", vi, e
+                )
+                continue
             att = t.Attestation.default()
             bits = [False] * int(duty["committee_length"])
             bits[int(duty["validator_committee_index"])] = True

@@ -54,7 +54,8 @@ def test_constant_blob_commitment_and_proof(setup):
     from lodestar_tpu.crypto.bls.fields import R
 
     c = 0x1234567
-    blob = c.to_bytes(32, "big") * FIELD_ELEMENTS_PER_BLOB_MAINNET
+    # early-4844 wire convention: field elements little-endian
+    blob = c.to_bytes(32, "little") * FIELD_ELEMENTS_PER_BLOB_MAINNET
     commitment = blob_to_kzg_commitment(blob, device=True)
     # constant polynomial: commitment == [c]G1
     assert commitment == g1_to_bytes(C.g1_mul(C.G1_GEN, c))
@@ -82,7 +83,7 @@ def test_aggregate_kzg_proof_roundtrip_and_tamper():
             h = int.from_bytes(
                 _hashlib.sha256(bytes([seed]) + i.to_bytes(4, "big")).digest(), "big"
             ) % K.R
-            out += h.to_bytes(32, "big")
+            out += h.to_bytes(32, K.KZG_ENDIANNESS)
         return out
 
     b1, b2 = blob_of(9), blob_of(10)

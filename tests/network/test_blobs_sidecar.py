@@ -36,7 +36,8 @@ def _blob(seed: int, p) -> bytes:
         h = int.from_bytes(
             hashlib.sha256(bytes([seed]) + i.to_bytes(4, "big")).digest(), "big"
         ) % kzg.R
-        out += h.to_bytes(32, "big")
+        # early-4844 wire convention: field elements little-endian
+        out += h.to_bytes(32, kzg.KZG_ENDIANNESS)
     return out
 
 

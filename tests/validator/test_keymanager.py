@@ -90,45 +90,61 @@ def test_keymanager_rest_server(minimal_preset):
     server = create_keymanager_server(km, port=0)
     server.start()
     base = f"http://127.0.0.1:{server.port}"
+    auth = {"Authorization": f"Bearer {server.auth_token}"}
+
+    def open_auth(url, **kw):
+        headers = {**auth, **kw.pop("headers", {})}
+        return urllib.request.urlopen(urllib.request.Request(url, headers=headers, **kw))
+
     try:
-        with urllib.request.urlopen(base + "/eth/v1/keystores") as r:
+        # no/garbage token -> 401 on every route (api-token.txt scheme)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/eth/v1/keystores")
+        assert exc.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    base + "/eth/v1/keystores",
+                    headers={"Authorization": "Bearer wrong"},
+                )
+            )
+        assert exc.value.code == 401
+
+        with open_auth(base + "/eth/v1/keystores") as r:
             data = json.loads(r.read())["data"]
         assert len(data) == 2
 
         # DELETE with body
-        req = urllib.request.Request(
+        with open_auth(
             base + "/eth/v1/keystores",
             method="DELETE",
             data=json.dumps({"pubkeys": ["0x" + sks[0].to_pubkey().hex()]}).encode(),
-        )
-        with urllib.request.urlopen(req) as r:
+        ) as r:
             out = json.loads(r.read())
         assert out["data"][0]["status"] == "deleted"
         assert "slashing_protection" in out
 
         # fee recipient roundtrip over HTTP
         pk_hex = "0x" + sks[1].to_pubkey().hex()
-        req = urllib.request.Request(
+        with open_auth(
             base + f"/eth/v1/validator/{pk_hex}/feerecipient",
             method="POST",
             data=json.dumps({"ethaddress": "0x" + "cc" * 20}).encode(),
-        )
-        with urllib.request.urlopen(req) as r:
+        ) as r:
             assert r.status == 202
-        with urllib.request.urlopen(base + f"/eth/v1/validator/{pk_hex}/feerecipient") as r:
+        with open_auth(base + f"/eth/v1/validator/{pk_hex}/feerecipient") as r:
             assert json.loads(r.read())["data"]["ethaddress"] == "0x" + "cc" * 20
 
         # bad input -> 400, unknown route -> 404
-        req = urllib.request.Request(
-            base + f"/eth/v1/validator/{pk_hex}/gas_limit",
-            method="POST",
-            data=json.dumps({"gas_limit": -5}).encode(),
-        )
         with pytest.raises(urllib.error.HTTPError) as exc:
-            urllib.request.urlopen(req)
+            open_auth(
+                base + f"/eth/v1/validator/{pk_hex}/gas_limit",
+                method="POST",
+                data=json.dumps({"gas_limit": -5}).encode(),
+            )
         assert exc.value.code == 400
         with pytest.raises(urllib.error.HTTPError) as exc:
-            urllib.request.urlopen(base + "/eth/v1/nonsense")
+            open_auth(base + "/eth/v1/nonsense")
         assert exc.value.code == 404
     finally:
         server.stop()
