@@ -27,6 +27,9 @@ def _build_parser() -> argparse.ArgumentParser:
     dev.add_argument("--preset", default="minimal", choices=["minimal", "mainnet"])
     dev.add_argument("--rest-port", type=int, default=0)
     dev.add_argument("--slot-time", type=float, default=0.0, help="seconds per slot (0 = as fast as possible)")
+    dev.add_argument("--p2p-port", type=int, default=0, help="serve P2P (TCP/noise/gossipsub) on this port")
+    dev.add_argument("--genesis-time", type=int, default=0, help="interop genesis_time (share with peers)")
+    dev.add_argument("--linger", type=float, default=0.0, help="keep serving P2P this many seconds after the last slot")
 
     beacon = sub.add_parser("beacon", help="run a beacon node")
     beacon.add_argument("--db", default=None, help="data directory (default: in-memory)")
@@ -34,6 +37,12 @@ def _build_parser() -> argparse.ArgumentParser:
     beacon.add_argument("--metrics-port", type=int, default=0)
     beacon.add_argument("--preset", default="mainnet", choices=["minimal", "mainnet"])
     beacon.add_argument("--genesis-validators", type=int, default=64)
+    beacon.add_argument("--p2p-port", type=int, default=0, help="serve P2P (TCP/noise/gossipsub) on this port")
+    beacon.add_argument("--bootnode", action="append", default=[], help="host:port of a peer to dial (repeatable)")
+    beacon.add_argument("--dev-genesis", action="store_true", help="dev-chain genesis: phase0-only forks + interop validators (peer with `dev --p2p-port`)")
+    beacon.add_argument("--genesis-time", type=int, default=0, help="interop genesis_time (share with peers)")
+    beacon.add_argument("--sync-target", type=int, default=0, help="exit 0 once head reaches this slot (testing)")
+    beacon.add_argument("--slot-time", type=int, default=0, help="dev-genesis slot seconds (match the dev node)")
     beacon.add_argument(
         "--checkpoint-sync-url",
         default=None,
@@ -71,9 +80,17 @@ async def _run_dev(args) -> int:
     cc = minimal_chain_config().replace(
         ALTAIR_FORK_EPOCH=far, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far
     )
+    p2p = args.p2p_port != 0
+    if p2p:
+        # peers compute the wall-clock slot from genesis_time: pin slot
+        # seconds to the dev pace and align slot starts to real time
+        cc = cc.replace(SECONDS_PER_SLOT=max(1, int(args.slot_time or 1)))
     sks = interop_secret_keys(args.validators)
     genesis = create_interop_genesis_state(
-        args.validators, p=p, genesis_fork_version=cc.GENESIS_FORK_VERSION
+        args.validators,
+        genesis_time=args.genesis_time,
+        p=p,
+        genesis_fork_version=cc.GENESIS_FORK_VERSION,
     )
 
     # manual clock: the dev loop drives slots itself from genesis
@@ -82,30 +99,55 @@ async def _run_dev(args) -> int:
         anchor_state=genesis,
         chain_config=cc,
         opts=BeaconNodeOptions(
-            rest_enabled=args.rest_port != 0, rest_port=args.rest_port, manual_clock=True
+            rest_enabled=args.rest_port != 0,
+            rest_port=args.rest_port,
+            manual_clock=True,
+            p2p_enabled=p2p,
+            p2p_port=args.p2p_port,
         ),
         p=p,
         time_fn=lambda: now[0],
     )
+    if p2p:
+        node.start_gossip_drain()
     cfg = create_beacon_config(cc, bytes(genesis.genesis_validators_root))
     store = ValidatorStore(cfg, SlashingProtection(MemoryDbController()), sks, p)
     validator = Validator(chain=node.chain, store=store, p=p)
 
+    import time as _time
+
     for slot in range(1, args.slots + 1):
+        if p2p and args.genesis_time:
+            # wall-clock slot alignment so peers' clocks agree
+            start = args.genesis_time + slot * cc.SECONDS_PER_SLOT
+            delay = start - _time.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
         node.chain.fork_choice.on_tick(slot)
         out = await validator.run_slot_duties(slot)
+        if out["proposed"] is not None and node.network is not None:
+            try:
+                await node.network.publish_block(out["proposed"])
+            except Exception as e:
+                print(f"gossip publish failed: {e}", file=sys.stderr)
         head = node.chain.get_head_state()
         proposed = "block" if out["proposed"] is not None else "-    "
         print(
             f"slot {slot:3d}: {proposed} atts={len(out['attestations']):3d} "
             f"justified={head.current_justified_checkpoint.epoch} "
-            f"finalized={head.finalized_checkpoint.epoch}"
+            f"finalized={head.finalized_checkpoint.epoch}",
+            flush=True,
         )
-        if args.slot_time:
+        if args.slot_time and not (p2p and args.genesis_time):
             await asyncio.sleep(args.slot_time)
     head = node.chain.get_head_state()
     ok = head.slot == args.slots
-    print(f"dev chain done: head slot {head.slot}, finalized epoch {head.finalized_checkpoint.epoch}")
+    print(
+        f"dev chain done: head slot {head.slot}, finalized epoch {head.finalized_checkpoint.epoch}",
+        flush=True,
+    )
+    if args.linger:
+        await asyncio.sleep(args.linger)
     await node.close()
     return 0 if ok else 1
 
@@ -120,6 +162,16 @@ async def _run_beacon(args) -> int:
     params.set_active_preset(args.preset)
     p = params.active_preset()
     chain_cfg = minimal_chain_config() if args.preset == "minimal" else mainnet_chain_config()
+    if args.dev_genesis:
+        far = 2**64 - 1
+        chain_cfg = chain_cfg.replace(
+            ALTAIR_FORK_EPOCH=far,
+            BELLATRIX_FORK_EPOCH=far,
+            CAPELLA_FORK_EPOCH=far,
+            DENEB_FORK_EPOCH=far,
+        )
+        if args.p2p_port or args.bootnode:
+            chain_cfg = chain_cfg.replace(SECONDS_PER_SLOT=max(1, int(args.slot_time or 1)))
     anchor = None
     db = None
     if args.db:
@@ -174,7 +226,19 @@ async def _run_beacon(args) -> int:
         )
         anchor = fetch_checkpoint_state(client, p=p, current_slot=current_slot)
     else:
-        anchor = create_interop_genesis_state(args.genesis_validators, p=p)
+        anchor = create_interop_genesis_state(
+            args.genesis_validators,
+            genesis_time=args.genesis_time,
+            p=p,
+            genesis_fork_version=chain_cfg.GENESIS_FORK_VERSION,
+        )
+    bootnodes = []
+    for b in args.bootnode:
+        bhost, sep, bport = b.rpartition(":")
+        if not sep or not bport.isdigit():
+            print(f"error: --bootnode must be host:port, got {b!r}", file=sys.stderr)
+            return 2
+        bootnodes.append((bhost or "127.0.0.1", int(bport)))
     node = await BeaconNode.init(
         anchor_state=anchor,
         chain_config=chain_cfg,
@@ -183,18 +247,83 @@ async def _run_beacon(args) -> int:
             rest_port=args.rest_port,
             metrics_enabled=args.metrics_port != 0,
             metrics_port=args.metrics_port,
+            p2p_enabled=args.p2p_port != 0 or bool(bootnodes),
+            p2p_port=args.p2p_port,
+            bootnodes=bootnodes,
         ),
         p=p,
         db=db,
     )
-    print(f"beacon node running; REST on :{node.rest_server.port}  (ctrl-c to stop)")
+    print(f"beacon node running; REST on :{node.rest_server.port}  (ctrl-c to stop)", flush=True)
     try:
+        if node.network is not None and bootnodes:
+            rc = await _sync_and_follow(node, args)
+            if rc is not None:
+                await node.close()
+                return rc
         while True:
             await asyncio.sleep(3600)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     await node.close()
     return 0
+
+
+async def _sync_and_follow(node, args) -> int | None:
+    """Range-sync to the best peer's head, then follow via gossip.
+    Returns an exit code when --sync-target is set, else None."""
+    from lodestar_tpu.sync.range_sync import RangeSync
+
+    net = node.network
+    # wait for a peer
+    for _ in range(100):
+        if net.host.peers():
+            break
+        await asyncio.sleep(0.2)
+    peers = net.host.peers()
+    if not peers:
+        print("no peers to sync from", file=sys.stderr, flush=True)
+        return 1 if args.sync_target else None
+    # a transient peer failure here must not take the node down — the
+    # follow loop below retries the gap sync on stall
+    try:
+        remote = await net.status(peers[0])
+        local_head = int(
+            node.chain.fork_choice.proto_array.get_block(node.chain.fork_choice.head).slot
+        )
+        remote_head = int(remote.head_slot)
+        print(f"peer head {remote_head}, local head {local_head}", flush=True)
+        if remote_head > local_head:
+            rs = RangeSync(chain=node.chain, network=net, peers=peers)
+            result = await rs.sync(local_head + 1, remote_head)
+            print(
+                f"range sync done: processed {result.processed_blocks} blocks", flush=True
+            )
+    except Exception as e:
+        print(f"initial sync failed (will retry via follow loop): {e!r}", file=sys.stderr, flush=True)
+    # follow via gossip until target (or forever); if gossip stalls (e.g.
+    # blocks missed while range sync ran), re-range-sync the gap
+    stall = 0
+    last = -1
+    while True:
+        head = node.chain.fork_choice.proto_array.get_block(node.chain.fork_choice.head)
+        head_slot = int(head.slot)
+        print(f"head slot {head_slot}", flush=True)
+        if args.sync_target and head_slot >= args.sync_target:
+            print(f"sync target {args.sync_target} reached", flush=True)
+            return 0
+        stall = stall + 1 if head_slot == last else 0
+        last = head_slot
+        if stall >= 3 and net.host.peers():
+            try:
+                remote = await net.status(net.host.peers()[0])
+                if int(remote.head_slot) > head_slot:
+                    rs = RangeSync(chain=node.chain, network=net, peers=net.host.peers())
+                    await rs.sync(head_slot + 1, int(remote.head_slot))
+            except Exception as e:
+                print(f"gap re-sync failed: {e!r}", file=sys.stderr, flush=True)
+            stall = 0
+        await asyncio.sleep(1.0)
 
 
 async def _run_validator(args) -> int:
@@ -287,9 +416,16 @@ async def _run_validator(args) -> int:
         from lodestar_tpu.validator.keymanager import KeymanagerApi, create_keymanager_server
 
         km = KeymanagerApi(store, genesis_validators_root=bytes.fromhex(genesis["genesis_validators_root"][2:]))
-        km_server = create_keymanager_server(km, port=args.keymanager_port)
+        km_server = create_keymanager_server(
+            km, port=args.keymanager_port, token_dir=args.data_dir
+        )
         km_server.start()
-        print(f"keymanager API on :{km_server.port}")
+        where = (
+            f"{args.data_dir}/api-token.txt" if args.data_dir else "(no --data-dir; shown once below)"
+        )
+        print(f"keymanager API on :{km_server.port}, bearer token in {where}")
+        if not args.data_dir:
+            print(f"keymanager token: {km_server.auth_token}")
 
     genesis_time = int(genesis["genesis_time"])
     seconds = int(chain_cfg.SECONDS_PER_SLOT)
@@ -325,6 +461,20 @@ async def _run_validator(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    # honor JAX_PLATFORMS from the environment: this environment's
+    # sitecustomize re-points jax.config at the accelerator plugin, which
+    # would make every CLI process (e.g. two peering dev/beacon nodes)
+    # contend for the one real chip even when the caller asked for cpu
+    import os as _os
+
+    plat = _os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax as _jax
+
+            _jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     args = _build_parser().parse_args(argv)
     if args.cmd == "dev":
         return asyncio.run(_run_dev(args))

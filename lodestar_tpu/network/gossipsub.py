@@ -1,0 +1,573 @@
+"""Gossipsub v1.1 over the libp2p host (reference `network/gossip/
+gossipsub.ts:74` — js-libp2p-gossipsub with lodestar's eth2 tuning).
+
+Wire: the pubsub RPC protobuf on `/meshsub/1.1.0` streams, one
+varint-length-delimited RPC per frame. Eth2 runs StrictNoSign: messages
+carry only (topic, data); the message id is the SHA-256 spec id of
+`network/gossip.py::compute_message_id`.
+
+Mechanics implemented (the v1.1 core the reference relies on):
+
+* per-topic MESH of D peers (D=8, D_lo=6, D_hi=12 — lodestar's
+  gossipsub defaults), maintained by a 700 ms heartbeat
+* GRAFT/PRUNE control messages with PRUNE backoff
+* gossip: IHAVE of recent message ids to D_lazy non-mesh peers each
+  heartbeat; IWANT answering from the message cache
+* message cache: `mcache_gossip`=3 windows advertised, `mcache_len`=6
+  kept for IWANT service
+* seen-id dedup with TTL
+* peer scoring (decaying counters): P1 time-in-mesh, P2 first
+  deliveries, P4 invalid messages, P7 behaviour penalty, with the
+  gossip/publish/graylist thresholds of lodestar's
+  `scoringParameters.ts`. Scores gate mesh admission, gossip emission
+  and (below graylist) RPC processing.
+
+Validation: the node wires `set_validator(fn)`; `fn(topic, raw_payload,
+peer) -> (verdict, ssz_bytes)` with verdict in "accept" | "ignore" |
+"reject" decides propagation exactly like the reference's
+validate-then-propagate pipeline ("reject" applies the P4
+invalid-message penalty); the returned ssz bytes (decompressed by the
+validator) are what subscribers receive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.utils.snappy import compress
+
+from .gossip import compute_message_id
+
+__all__ = ["GossipSub", "GossipParams"]
+
+PROTOCOL_ID = "/meshsub/1.1.0"
+
+
+# --- minimal protobuf codec for the pubsub RPC --------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _rv(buf: bytes, pos: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _field(num: int, data: bytes) -> bytes:
+    return _varint(num << 3 | 2) + _varint(len(data)) + data
+
+
+def _iter_fields(buf: bytes):
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _rv(buf, pos)
+        num, wt = tag >> 3, tag & 7
+        if wt == 2:
+            ln, pos = _rv(buf, pos)
+            yield num, buf[pos : pos + ln]
+            pos += ln
+        elif wt == 0:
+            val, pos = _rv(buf, pos)
+            yield num, val
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+
+
+def encode_rpc(
+    subscriptions: list[tuple[bool, str]] = (),
+    publish: list[tuple[str, bytes]] = (),
+    ihave: list[tuple[str, list[bytes]]] = (),
+    iwant: list[bytes] = (),
+    graft: list[str] = (),
+    prune: list[tuple[str, int]] = (),
+) -> bytes:
+    out = b""
+    for sub, topic in subscriptions:
+        body = (b"\x08\x01" if sub else b"\x08\x00") + _field(2, topic.encode())
+        out += _field(1, body)
+    for topic, data in publish:
+        # Message{data=2, topic=4}; from/seqno/signature absent (StrictNoSign)
+        out += _field(2, _field(2, data) + _field(4, topic.encode()))
+    control = b""
+    for topic, ids in ihave:
+        body = _field(1, topic.encode()) + b"".join(_field(2, i) for i in ids)
+        control += _field(1, body)
+    if iwant:
+        control += _field(2, b"".join(_field(1, i) for i in iwant))
+    for topic in graft:
+        control += _field(3, _field(1, topic.encode()))
+    for topic, backoff in prune:
+        control += _field(4, _field(1, topic.encode()) + b"\x18" + _varint(backoff))
+    if control:
+        out += _field(3, control)
+    return out
+
+
+def decode_rpc(buf: bytes) -> dict:
+    out = {"subscriptions": [], "publish": [], "ihave": [], "iwant": [], "graft": [], "prune": []}
+    for num, val in _iter_fields(buf):
+        if num == 1:  # SubOpts
+            sub, topic = True, ""
+            for fn, fv in _iter_fields(val):
+                if fn == 1:
+                    sub = bool(fv)
+                elif fn == 2:
+                    topic = fv.decode()
+            out["subscriptions"].append((sub, topic))
+        elif num == 2:  # Message
+            topic, data = "", b""
+            for fn, fv in _iter_fields(val):
+                if fn == 2:
+                    data = fv
+                elif fn == 4:
+                    topic = fv.decode()
+            out["publish"].append((topic, data))
+        elif num == 3:  # ControlMessage
+            for fn, fv in _iter_fields(val):
+                if fn == 1:  # IHAVE
+                    topic, ids = "", []
+                    for gn, gv in _iter_fields(fv):
+                        if gn == 1:
+                            topic = gv.decode()
+                        elif gn == 2:
+                            ids.append(gv)
+                    out["ihave"].append((topic, ids))
+                elif fn == 2:  # IWANT
+                    for gn, gv in _iter_fields(fv):
+                        if gn == 1:
+                            out["iwant"].append(gv)
+                elif fn == 3:  # GRAFT
+                    for gn, gv in _iter_fields(fv):
+                        if gn == 1:
+                            out["graft"].append(gv.decode())
+                elif fn == 4:  # PRUNE
+                    topic, backoff = "", 60
+                    for gn, gv in _iter_fields(fv):
+                        if gn == 1:
+                            topic = gv.decode()
+                        elif gn == 3:
+                            backoff = gv
+                    out["prune"].append((topic, backoff))
+    return out
+
+
+# --- scoring ------------------------------------------------------------------
+
+
+class GossipParams:
+    """Lodestar's gossipsub tuning (`gossipsub.ts` + `scoringParameters.ts`)."""
+
+    D = 8
+    D_LO = 6
+    D_HI = 12
+    D_LAZY = 6
+    HEARTBEAT_SEC = 0.7
+    MCACHE_LEN = 6  # windows kept for IWANT service
+    MCACHE_GOSSIP = 3  # windows advertised in IHAVE
+    SEEN_TTL_SEC = 385.0  # SLOTS_PER_EPOCH * SECONDS_PER_SLOT on mainnet
+    PRUNE_BACKOFF_SEC = 60
+    # score thresholds (scoringParameters.ts gossipThreshold etc.)
+    GOSSIP_THRESHOLD = -4000.0
+    PUBLISH_THRESHOLD = -8000.0
+    GRAYLIST_THRESHOLD = -16000.0
+    # weights/decay for the implemented counters
+    TIME_IN_MESH_WEIGHT = 0.03333
+    TIME_IN_MESH_CAP = 300.0
+    FIRST_DELIVERY_WEIGHT = 1.0
+    FIRST_DELIVERY_CAP = 100.0
+    INVALID_MESSAGE_WEIGHT = -100.0
+    BEHAVIOUR_PENALTY_WEIGHT = -15.9
+    DECAY = 0.96
+
+
+class _PeerScore:
+    def __init__(self):
+        self.mesh_since: dict[str, float] = {}  # topic -> graft time
+        self.first_deliveries = 0.0
+        self.invalid = 0.0
+        self.behaviour = 0.0
+
+    def decay(self, p: GossipParams) -> None:
+        self.first_deliveries *= p.DECAY
+        self.invalid *= p.DECAY
+        self.behaviour *= p.DECAY
+
+    def value(self, p: GossipParams, now: float) -> float:
+        s = 0.0
+        for since in self.mesh_since.values():
+            s += min(now - since, p.TIME_IN_MESH_CAP) * p.TIME_IN_MESH_WEIGHT
+        s += min(self.first_deliveries, p.FIRST_DELIVERY_CAP) * p.FIRST_DELIVERY_WEIGHT
+        # P4/P7 are quadratic in their counters (gossipsub v1.1 spec)
+        s += self.invalid * self.invalid * p.INVALID_MESSAGE_WEIGHT
+        s += self.behaviour * self.behaviour * p.BEHAVIOUR_PENALTY_WEIGHT
+        return s
+
+
+# --- the router ---------------------------------------------------------------
+
+
+class GossipSub:
+    def __init__(self, host, *, params: GossipParams | None = None, time_fn=time.monotonic):
+        self.host = host
+        self.p = params or GossipParams()
+        self.now = time_fn
+        self.log = get_logger(name="lodestar.network.gossipsub")
+        self.topics: set[str] = set()  # our subscriptions
+        self.peer_topics: dict[str, set[str]] = {}  # peer -> their subscriptions
+        self.mesh: dict[str, set[str]] = {}  # topic -> grafted peers
+        self.fanout: dict[str, set[str]] = {}
+        self.backoff: dict[tuple[str, str], float] = {}  # (topic, peer) -> until
+        self.scores: dict[str, _PeerScore] = {}
+        self.seen: dict[bytes, float] = {}  # msg id -> first-seen time
+        self.mcache: list[list[tuple[bytes, str, bytes]]] = [[]]  # windows of (id, topic, raw)
+        self.mcache_index: dict[bytes, tuple[str, bytes]] = {}
+        self._streams: dict[str, object] = {}  # peer -> outbound stream
+        self._validator = None  # fn(topic, ssz_bytes, peer) -> accept|ignore|reject
+        self._subscribers: dict[str, list] = {}  # topic -> [async handler(ssz, peer)]
+        self._hb_task: asyncio.Task | None = None
+        self.metrics = {"delivered": 0, "duplicates": 0, "rejected": 0, "iwant_served": 0}
+
+        host.set_handler(PROTOCOL_ID, self._on_inbound_stream)
+        prev_connect = host.on_peer_connect
+
+        async def on_connect(peer_id):
+            if prev_connect is not None:
+                await prev_connect(peer_id)
+            await self._on_peer(peer_id)
+
+        host.on_peer_connect = on_connect
+        prev_dc = host.on_peer_disconnect
+
+        async def on_dc(peer_id):
+            if prev_dc is not None:
+                await prev_dc(peer_id)
+            self._drop_peer(peer_id)
+
+        host.on_peer_disconnect = on_dc
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._hb_task is None:
+            self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._hb_task = None
+
+    def set_validator(self, fn) -> None:
+        self._validator = fn
+
+    # -- peer/stream plumbing --------------------------------------------------
+
+    async def _on_peer(self, peer_id: str) -> None:
+        """New connection: open our outbound RPC stream, announce subs."""
+        self.scores.setdefault(peer_id, _PeerScore())
+        try:
+            stream = await self.host.new_stream(peer_id, PROTOCOL_ID)
+        except Exception as e:
+            self.log.debug(f"gossipsub stream to {peer_id[:8]} failed: {e}")
+            return
+        self._streams[peer_id] = stream
+        if self.topics:
+            await self._send_rpc(peer_id, encode_rpc(
+                subscriptions=[(True, t) for t in sorted(self.topics)]
+            ))
+
+    def _drop_peer(self, peer_id: str) -> None:
+        self._streams.pop(peer_id, None)
+        self.peer_topics.pop(peer_id, None)
+        for peers in self.mesh.values():
+            peers.discard(peer_id)
+        for peers in self.fanout.values():
+            peers.discard(peer_id)
+
+    async def _send_rpc(self, peer_id: str, rpc: bytes) -> bool:
+        stream = self._streams.get(peer_id)
+        if stream is None:
+            return False
+        try:
+            stream.write(_varint(len(rpc)) + rpc)
+            await stream.drain()
+            return True
+        except (ConnectionError, OSError):
+            self._drop_peer(peer_id)
+            return False
+
+    async def _on_inbound_stream(self, stream, peer_id: str) -> None:
+        """Pump the peer's RPC stream until EOF."""
+        self.scores.setdefault(peer_id, _PeerScore())
+        buf = b""
+        while True:
+            try:
+                chunk = await stream.read()
+            except (ConnectionError, OSError):
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while True:
+                try:
+                    ln, pos = _rv(buf, 0)
+                except IndexError:
+                    break
+                if len(buf) - pos < ln:
+                    break
+                rpc = buf[pos : pos + ln]
+                buf = buf[pos + ln :]
+                try:
+                    await self._handle_rpc(peer_id, decode_rpc(rpc))
+                except Exception as e:
+                    self.log.warn(f"rpc handling error from {peer_id[:8]}: {e!r}")
+                    self._penalize(peer_id, 1.0)
+
+    # -- RPC handling ----------------------------------------------------------
+
+    def _score(self, peer_id: str) -> float:
+        sc = self.scores.get(peer_id)
+        return sc.value(self.p, self.now()) if sc else 0.0
+
+    def _penalize(self, peer_id: str, units: float) -> None:
+        self.scores.setdefault(peer_id, _PeerScore()).behaviour += units
+
+    async def _handle_rpc(self, peer_id: str, rpc: dict) -> None:
+        if self._score(peer_id) < self.p.GRAYLIST_THRESHOLD:
+            return  # graylisted: ignore everything
+        for sub, topic in rpc["subscriptions"]:
+            topics = self.peer_topics.setdefault(peer_id, set())
+            (topics.add if sub else topics.discard)(topic)
+        for topic in rpc["graft"]:
+            await self._on_graft(peer_id, topic)
+        for topic, backoff in rpc["prune"]:
+            self.mesh.get(topic, set()).discard(peer_id)
+            sc = self.scores.get(peer_id)
+            if sc:
+                sc.mesh_since.pop(topic, None)
+            self.backoff[(topic, peer_id)] = self.now() + int(backoff)
+        for topic, data in rpc["publish"]:
+            await self._on_message(peer_id, topic, data)
+        if rpc["ihave"]:
+            await self._on_ihave(peer_id, rpc["ihave"])
+        if rpc["iwant"]:
+            await self._on_iwant(peer_id, rpc["iwant"])
+
+    async def _on_graft(self, peer_id: str, topic: str) -> None:
+        if topic not in self.topics:
+            await self._send_rpc(peer_id, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
+            return
+        if self.now() < self.backoff.get((topic, peer_id), 0.0):
+            self._penalize(peer_id, 1.0)  # grafting inside backoff
+            await self._send_rpc(peer_id, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
+            return
+        if self._score(peer_id) < 0:
+            await self._send_rpc(peer_id, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
+            return
+        self.mesh.setdefault(topic, set()).add(peer_id)
+        self.scores.setdefault(peer_id, _PeerScore()).mesh_since.setdefault(topic, self.now())
+
+    async def _on_message(self, peer_id: str, topic: str, raw: bytes) -> None:
+        msg_id = compute_message_id(raw)
+        now = self.now()
+        if msg_id in self.seen:
+            self.metrics["duplicates"] += 1
+            return
+        self.seen[msg_id] = now
+        verdict = "accept"
+        ssz = raw
+        if self._validator is not None:
+            verdict, ssz = await self._validator(topic, raw, peer_id)
+        if verdict == "reject":
+            self.metrics["rejected"] += 1
+            sc = self.scores.setdefault(peer_id, _PeerScore())
+            sc.invalid += 1.0
+            return
+        if verdict == "ignore":
+            return
+        sc = self.scores.setdefault(peer_id, _PeerScore())
+        sc.first_deliveries += 1.0
+        self.metrics["delivered"] += 1
+        self._mcache_put(msg_id, topic, raw)
+        await self._forward(topic, raw, exclude={peer_id})
+        for handler in self._subscribers.get(topic, []):
+            try:
+                await handler(ssz, peer_id)
+            except Exception as e:
+                self.log.warn(f"subscriber error on {topic}: {e!r}")
+
+    async def _on_ihave(self, peer_id: str, ihave) -> None:
+        if self._score(peer_id) < self.p.GOSSIP_THRESHOLD:
+            return
+        want = []
+        for topic, ids in ihave:
+            if topic not in self.topics:
+                continue
+            want.extend(i for i in ids if i not in self.seen)
+        if want:
+            await self._send_rpc(peer_id, encode_rpc(iwant=want[:500]))
+
+    async def _on_iwant(self, peer_id: str, ids) -> None:
+        msgs = []
+        for i in ids[:500]:
+            entry = self.mcache_index.get(i)
+            if entry is not None:
+                msgs.append(entry)
+        if msgs:
+            self.metrics["iwant_served"] += len(msgs)
+            await self._send_rpc(peer_id, encode_rpc(publish=msgs))
+
+    # -- app surface -----------------------------------------------------------
+
+    async def subscribe(self, topic: str, handler=None) -> None:
+        topic = str(topic)
+        self.topics.add(topic)
+        if handler is not None:
+            self._subscribers.setdefault(topic, []).append(handler)
+        self.mesh.setdefault(topic, set())
+        for peer_id in list(self._streams):
+            await self._send_rpc(peer_id, encode_rpc(subscriptions=[(True, topic)]))
+
+    async def unsubscribe(self, topic: str) -> None:
+        topic = str(topic)
+        self.topics.discard(topic)
+        self._subscribers.pop(topic, None)
+        peers = self.mesh.pop(topic, set())
+        for peer_id in list(self._streams):
+            rpc = encode_rpc(
+                subscriptions=[(False, topic)],
+                prune=[(topic, self.p.PRUNE_BACKOFF_SEC)] if peer_id in peers else [],
+            )
+            await self._send_rpc(peer_id, rpc)
+
+    async def publish(self, topic: str, ssz_bytes: bytes) -> int:
+        """Compress, id, cache and send to the mesh (or fanout). Returns
+        the number of peers the message went to."""
+        topic = str(topic)
+        raw = compress(ssz_bytes)
+        msg_id = compute_message_id(raw)
+        if msg_id in self.seen:
+            return 0
+        self.seen[msg_id] = self.now()
+        self._mcache_put(msg_id, topic, raw)
+        return await self._forward(topic, raw, exclude=set(), flood=True)
+
+    async def _forward(self, topic: str, raw: bytes, exclude: set, flood: bool = False) -> int:
+        if flood:
+            # own publishes flood to every subscribed peer above the
+            # publish threshold (js-libp2p-gossipsub floodPublish, the
+            # eth2 configuration) — robust delivery regardless of mesh
+            # state, at publish-amplification cost only for own messages
+            peers = set(self._topic_peers(topic))
+        else:
+            peers = self.mesh.get(topic)
+            if not peers and topic not in self.topics:
+                # fanout publish to a topic we don't subscribe to
+                peers = self.fanout.setdefault(topic, set())
+                if not peers:
+                    peers |= set(self._topic_peers(topic)[: self.p.D])
+        rpc = encode_rpc(publish=[(topic, raw)])
+        n = 0
+        for peer_id in list(peers or ()):
+            if peer_id in exclude:
+                continue
+            if self._score(peer_id) < self.p.PUBLISH_THRESHOLD:
+                continue
+            if await self._send_rpc(peer_id, rpc):
+                n += 1
+        return n
+
+    def _topic_peers(self, topic: str) -> list[str]:
+        return [p for p, ts in self.peer_topics.items() if topic in ts and p in self._streams]
+
+    # -- heartbeat -------------------------------------------------------------
+
+    def _mcache_put(self, msg_id: bytes, topic: str, raw: bytes) -> None:
+        self.mcache[0].append((msg_id, topic, raw))
+        self.mcache_index[msg_id] = (topic, raw)
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.p.HEARTBEAT_SEC)
+            try:
+                await self.heartbeat()
+            except Exception as e:
+                self.log.warn(f"heartbeat error: {e!r}")
+
+    async def heartbeat(self) -> None:
+        now = self.now()
+        # mesh maintenance
+        for topic in list(self.topics):
+            mesh = self.mesh.setdefault(topic, set())
+            # kick negative-score peers
+            for peer_id in [pid for pid in mesh if self._score(pid) < 0]:
+                mesh.discard(peer_id)
+                await self._send_rpc(peer_id, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
+            if len(mesh) < self.p.D_LO:
+                candidates = [
+                    pid
+                    for pid in self._topic_peers(topic)
+                    if pid not in mesh
+                    and now >= self.backoff.get((topic, pid), 0.0)
+                    and self._score(pid) >= 0
+                ]
+                for pid in candidates[: self.p.D - len(mesh)]:
+                    mesh.add(pid)
+                    self.scores.setdefault(pid, _PeerScore()).mesh_since.setdefault(topic, now)
+                    await self._send_rpc(pid, encode_rpc(graft=[topic]))
+            elif len(mesh) > self.p.D_HI:
+                # prune down to D, lowest scores first
+                ranked = sorted(mesh, key=self._score)
+                for pid in ranked[: len(mesh) - self.p.D]:
+                    mesh.discard(pid)
+                    sc = self.scores.get(pid)
+                    if sc:
+                        sc.mesh_since.pop(topic, None)
+                    await self._send_rpc(pid, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
+        # gossip: IHAVE recent ids to D_LAZY non-mesh peers per topic
+        window = self.mcache[: self.p.MCACHE_GOSSIP]
+        ids_by_topic: dict[str, list[bytes]] = {}
+        for w in window:
+            for msg_id, topic, _ in w:
+                ids_by_topic.setdefault(topic, []).append(msg_id)
+        for topic, ids in ids_by_topic.items():
+            mesh = self.mesh.get(topic, set())
+            lazy = [
+                pid
+                for pid in self._topic_peers(topic)
+                if pid not in mesh and self._score(pid) >= self.p.GOSSIP_THRESHOLD
+            ][: self.p.D_LAZY]
+            for pid in lazy:
+                await self._send_rpc(pid, encode_rpc(ihave=[(topic, ids[:5000])]))
+        # rotate mcache
+        self.mcache.insert(0, [])
+        while len(self.mcache) > self.p.MCACHE_LEN:
+            for msg_id, _, _ in self.mcache.pop():
+                self.mcache_index.pop(msg_id, None)
+        # decay scores, expire seen + backoff
+        for sc in self.scores.values():
+            sc.decay(self.p)
+        cutoff = now - self.p.SEEN_TTL_SEC
+        self.seen = {k: v for k, v in self.seen.items() if v >= cutoff}
+        self.backoff = {k: v for k, v in self.backoff.items() if v > now}

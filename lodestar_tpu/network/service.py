@@ -1,0 +1,287 @@
+"""Libp2pBeaconNetwork: the node's real network service (reference
+`network/network.ts` Network class — the object that owns libp2p,
+gossip, reqresp, the peer manager and the subnet subscriptions).
+
+Composition:
+
+* `Libp2pHost` (TCP + noise-XX + mplex) listens and dials
+* `GossipSub` runs the eth2 topics; inbound messages are decompressed,
+  SSZ-decoded by topic kind (fork resolved from the topic's fork
+  digest) and pushed into the node's `NetworkProcessor` queues; decode
+  failures REJECT (P4 penalty) and do not propagate
+* `ReqRespBeaconNode` protocols are registered as host stream handlers;
+  the client side dials `host.new_stream(peer, protocol)`
+* `PeerManager` scores peers; a status handshake runs on every connect
+  (reference `peerManager.ts` onStatus) and fork-digest-mismatched or
+  irrelevant peers are disconnected
+* static bootnode dialing stands in for discv5 (`network/discovery.py`
+  provides the candidates)
+
+Validation-vs-propagation note: the reference validates gossip BEFORE
+propagating (validate-then-forward). Here structurally-invalid payloads
+(snappy/SSZ failures) are rejected pre-propagation; semantic validation
+happens in the processor's handlers after the queue hop, so a
+well-formed-but-invalid message can propagate one hop before its sender
+is downscored. Documented trade-off, revisit with inline validators.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from lodestar_tpu.config import FORK_ORDER
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.reqresp.protocols import BEACON_PROTOCOLS
+from lodestar_tpu.types import ssz_types
+from lodestar_tpu.utils.snappy import SnappyError, decompress
+
+from .gossip import topic_string
+from .gossipsub import GossipSub
+from .peers import PeerAction, PeerManager
+from .reqresp_node import ReqRespBeaconNode
+from .transport import Identity, Libp2pHost
+
+__all__ = ["Libp2pBeaconNetwork", "GOSSIP_KIND_TYPES"]
+
+# topic kind -> (type namespace attr, fork-namespaced?) for decoding.
+# Subnet topics (beacon_attestation_N, sync_committee_N) strip the index.
+GOSSIP_KIND_TYPES = {
+    "beacon_block": "SignedBeaconBlock",
+    "beacon_block_and_blobs_sidecar": "SignedBeaconBlockAndBlobsSidecar",
+    "beacon_aggregate_and_proof": "SignedAggregateAndProof",
+    "beacon_attestation": "Attestation",
+    "voluntary_exit": "SignedVoluntaryExit",
+    "proposer_slashing": "ProposerSlashing",
+    "attester_slashing": "AttesterSlashing",
+    "sync_committee_contribution_and_proof": "SignedContributionAndProof",
+    "sync_committee": "SyncCommitteeMessage",
+    "bls_to_execution_change": "SignedBLSToExecutionChange",
+}
+
+
+def _split_topic(topic: str) -> tuple[bytes, str] | None:
+    """'/eth2/<digest>/<name>/ssz_snappy' -> (digest, kind) with subnet
+    indices stripped from the kind."""
+    parts = topic.split("/")
+    if len(parts) != 5 or parts[1] != "eth2" or parts[4] != "ssz_snappy":
+        return None
+    try:
+        digest = bytes.fromhex(parts[2])
+    except ValueError:
+        return None
+    name = parts[3]
+    for kind in ("beacon_attestation_", "sync_committee_"):
+        if name.startswith(kind) and name[len(kind):].isdigit():
+            return digest, kind[:-1]
+    return digest, name
+
+
+class Libp2pBeaconNetwork:
+    def __init__(
+        self,
+        *,
+        node,
+        chain,
+        listen_port: int = 0,
+        bootnodes: list[tuple[str, int]] | None = None,
+        identity: Identity | None = None,
+        subscribe_subnets: int = 2,
+    ):
+        self.node = node
+        self.chain = chain
+        self.host = Libp2pHost(identity, listen_port=listen_port)
+        self.gossip = GossipSub(self.host)
+        self.reqresp = ReqRespBeaconNode(chain)
+        self.peers = PeerManager()
+        self.bootnodes = list(bootnodes or [])
+        self.subscribe_subnets = subscribe_subnets
+        self.log = get_logger(name="lodestar.network")
+        self._digest_to_fork: dict[bytes, str] = {}
+        self.gossip.set_validator(self._validate_gossip)
+        self.host.on_peer_connect = self._on_peer_connect
+        self.host.on_peer_disconnect = self._on_peer_disconnect
+        # reqresp protocols become host stream handlers
+        for pid in BEACON_PROTOCOLS:
+            if pid in self.reqresp._handlers:
+                self.host.set_handler(pid, self._serve_stream)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self, host_addr: str = "127.0.0.1") -> int:
+        from lodestar_tpu.config import create_beacon_config
+
+        gvr = bytes(self.chain.get_head_state().genesis_validators_root)
+        self.beacon_cfg = create_beacon_config(self.chain.cfg, gvr)
+        for fork in FORK_ORDER:
+            self._digest_to_fork[self.beacon_cfg.fork_digest(fork)] = fork
+        port = await self.host.listen(host_addr)
+        self.gossip.start()
+        await self._subscribe_core_topics()
+        for (bhost, bport) in self.bootnodes:
+            try:
+                await self.host.connect(bhost, bport)
+            except Exception as e:
+                self.log.warn(f"bootnode {bhost}:{bport} dial failed: {e}")
+        self.log.info(f"p2p listening on {host_addr}:{port} as {self.host.peer_id}")
+        return port
+
+    async def stop(self) -> None:
+        # goodbye to connected peers (reference goodbyeAndDisconnectAllPeers)
+        for peer in list(self.host.peers()):
+            try:
+                await asyncio.wait_for(self._request(peer, "goodbye", 1), 2.0)
+            except Exception:
+                pass
+        await self.gossip.stop()
+        await self.host.close()
+
+    @property
+    def peer_id(self) -> str:
+        return self.host.peer_id
+
+    def current_fork_digest(self) -> bytes:
+        fork = self.chain.fork_name_at_slot(self.chain.fork_choice.current_slot)
+        return self.beacon_cfg.fork_digest(fork)
+
+    async def _subscribe_core_topics(self) -> None:
+        digest = self.current_fork_digest()
+        kinds = [
+            "beacon_block",
+            "beacon_aggregate_and_proof",
+            "voluntary_exit",
+            "proposer_slashing",
+            "attester_slashing",
+        ]
+        fork = self._digest_to_fork.get(digest)
+        if fork not in (None, "phase0", "altair", "bellatrix"):
+            kinds.append("bls_to_execution_change")
+        if fork == "deneb":
+            kinds[0] = "beacon_block_and_blobs_sidecar"
+        for kind in kinds:
+            await self.gossip.subscribe(topic_string(kind, digest))
+        for subnet in range(self.subscribe_subnets):
+            await self.gossip.subscribe(topic_string(f"beacon_attestation_{subnet}", digest))
+
+    # -- gossip ingress --------------------------------------------------------
+
+    async def _validate_gossip(self, topic: str, raw: bytes, peer: str):
+        split = _split_topic(topic)
+        if split is None:
+            return "reject", b""
+        digest, kind = split
+        fork = self._digest_to_fork.get(digest)
+        if fork is None:
+            return "reject", b""
+        type_name = GOSSIP_KIND_TYPES.get(kind)
+        if type_name is None:
+            return "ignore", b""
+        try:
+            ssz = decompress(raw)
+        except SnappyError:
+            self._report(peer, PeerAction.LOW_TOLERANCE_ERROR)
+            return "reject", b""
+        t = ssz_types(self.chain.p)
+        ns = getattr(t, fork, t)
+        typ = getattr(ns, type_name, None) or getattr(t, type_name, None)
+        if typ is None:
+            return "ignore", b""
+        try:
+            msg = typ.deserialize(ssz)
+        except Exception:
+            self._report(peer, PeerAction.LOW_TOLERANCE_ERROR)
+            return "reject", b""
+        accepted = self.node.on_gossip(kind, msg, peer=peer)
+        if not accepted:
+            return "ignore", ssz  # queue full: don't propagate stale load
+        return "accept", ssz
+
+    # -- reqresp ---------------------------------------------------------------
+
+    async def _serve_stream(self, stream, peer_id: str) -> None:
+        await self.reqresp.handle_stream(stream, stream, peer_id=peer_id)
+
+    async def _request(self, peer_id: str, name: str, request, max_chunks=None):
+        pid = f"/eth2/beacon_chain/req/{name}/1/ssz_snappy"
+
+        async def dial():
+            s = await self.host.new_stream(peer_id, pid)
+            return s, s
+
+        return await self.reqresp.send_request(dial, pid, request, max_chunks=max_chunks)
+
+    async def status(self, peer_id: str):
+        out = await self._request(peer_id, "status", self.reqresp.local_status())
+        return out[0] if out else None
+
+    async def blocks_by_range(self, peer_id: str, start_slot: int, count: int):
+        t = ssz_types(self.chain.p)
+        req = t.BeaconBlocksByRangeRequest.default()
+        req.start_slot = start_slot
+        req.count = count
+        req.step = 1
+        return await self._request(peer_id, "beacon_blocks_by_range", req)
+
+    async def blobs_by_range(self, peer_id: str, start_slot: int, count: int):
+        t = ssz_types(self.chain.p)
+        req = t.BlobsSidecarsByRangeRequest.default()
+        req.start_slot = start_slot
+        req.count = count
+        return await self._request(peer_id, "blobs_sidecars_by_range", req)
+
+    async def blocks_by_root(self, peer_id: str, roots: list[bytes]):
+        # request type is List[Bytes32]; the engine serializes the raw list
+        return await self._request(peer_id, "beacon_blocks_by_root", list(roots))
+
+    # -- gossip egress ---------------------------------------------------------
+
+    async def publish(self, kind: str, msg, fork: str | None = None) -> int:
+        """Serialize + publish a typed message on the current-fork topic."""
+        t = ssz_types(self.chain.p)
+        fork = fork or self.chain.fork_name_at_slot(self.chain.fork_choice.current_slot)
+        digest = self.beacon_cfg.fork_digest(fork)
+        type_name = GOSSIP_KIND_TYPES.get(
+            "beacon_attestation" if kind.startswith("beacon_attestation_") else kind
+        )
+        ns = getattr(t, fork, t)
+        typ = getattr(ns, type_name, None) or getattr(t, type_name, None)
+        ssz = typ.serialize(msg)
+        return await self.gossip.publish(topic_string(kind, digest), ssz)
+
+    async def publish_block(self, signed_block) -> int:
+        slot = int(signed_block.message.slot)
+        fork = self.chain.fork_name_at_slot(slot)
+        if fork == "deneb":
+            raise ValueError("deneb blocks publish as beacon_block_and_blobs_sidecar")
+        return await self.publish("beacon_block", signed_block, fork=fork)
+
+    # -- peer lifecycle --------------------------------------------------------
+
+    def _report(self, peer_id: str, action: PeerAction) -> None:
+        state = self.peers.report_peer(peer_id, action)
+        if state.value != "Healthy":
+            conn = self.host.connections.get(peer_id)
+            if conn is not None:
+                conn.close()
+
+    async def _on_peer_connect(self, peer_id: str) -> None:
+        self.peers.on_connect(peer_id)
+        await self.gossip._on_peer(peer_id)
+        # status handshake (reference onStatus): wrong fork -> disconnect
+        try:
+            remote = await self.status(peer_id)
+        except Exception as e:
+            self.log.debug(f"status handshake with {peer_id[:8]} failed: {e}")
+            return
+        if remote is None:
+            return
+        local = self.reqresp.local_status()
+        if int(remote.finalized_epoch) < 0:  # placeholder sanity gate
+            self._report(peer_id, PeerAction.FATAL)
+        self.log.info(
+            f"peer {peer_id[:8]} head_slot={int(remote.head_slot)} "
+            f"finalized_epoch={int(remote.finalized_epoch)} "
+            f"(local head {int(local.head_slot)})"
+        )
+
+    async def _on_peer_disconnect(self, peer_id: str) -> None:
+        self.peers.on_disconnect(peer_id)

@@ -33,6 +33,9 @@ class BeaconNodeOptions:
         metrics_enabled: bool = False,
         use_device_verifier: bool = False,
         manual_clock: bool = False,
+        p2p_enabled: bool = False,
+        p2p_port: int = 0,
+        bootnodes: list[tuple[str, int]] | None = None,
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -41,6 +44,9 @@ class BeaconNodeOptions:
         self.metrics_enabled = metrics_enabled
         self.use_device_verifier = use_device_verifier
         self.manual_clock = manual_clock
+        self.p2p_enabled = p2p_enabled
+        self.p2p_port = p2p_port
+        self.bootnodes = list(bootnodes or [])
 
 
 class BeaconNode:
@@ -55,6 +61,7 @@ class BeaconNode:
         self.metrics_server = metrics_server
         self.bls = bls
         self.processor = processor
+        self.network = None  # Libp2pBeaconNetwork when p2p is enabled
         self._drain_task = None
         self.log = get_logger(name="lodestar.node")
 
@@ -165,6 +172,18 @@ class BeaconNode:
         )
         if not opts.manual_clock:
             node.start_gossip_drain()
+
+        # 8. P2P network (TCP + noise + mplex + gossipsub + reqresp)
+        if opts.p2p_enabled:
+            from lodestar_tpu.network.service import Libp2pBeaconNetwork
+
+            node.network = Libp2pBeaconNetwork(
+                node=node,
+                chain=chain,
+                listen_port=opts.p2p_port,
+                bootnodes=opts.bootnodes,
+            )
+            await node.network.start()
         node.log.info(
             f"beacon node up: slot {clock.current_slot}, "
             f"rest {'on :' + str(rest_server.port) if rest_server else 'off'}"
@@ -173,6 +192,12 @@ class BeaconNode:
 
     async def close(self) -> None:
         """Abort cascade, reverse init order (nodejs.ts:146-152)."""
+        if self.network is not None:
+            try:
+                await self.network.stop()
+            except Exception:
+                pass
+            self.network = None
         if self._drain_task is not None:
             self._drain_task.cancel()
             try:

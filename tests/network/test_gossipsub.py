@@ -1,0 +1,150 @@
+"""Gossipsub v1.1 over real sockets: mesh formation, publish/deliver,
+dedup, IHAVE/IWANT recovery, validation penalties."""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.network.gossipsub import GossipSub, decode_rpc, encode_rpc
+from lodestar_tpu.network.transport import Libp2pHost
+from lodestar_tpu.utils.snappy import decompress
+
+TOPIC = "/eth2/00000000/beacon_block/ssz_snappy"
+
+
+def test_rpc_codec_roundtrip():
+    rpc = encode_rpc(
+        subscriptions=[(True, "a"), (False, "b")],
+        publish=[("t", b"payload")],
+        ihave=[("t", [b"\x01" * 20, b"\x02" * 20])],
+        iwant=[b"\x03" * 20],
+        graft=["t"],
+        prune=[("u", 60)],
+    )
+    out = decode_rpc(rpc)
+    assert out["subscriptions"] == [(True, "a"), (False, "b")]
+    assert out["publish"] == [("t", b"payload")]
+    assert out["ihave"] == [("t", [b"\x01" * 20, b"\x02" * 20])]
+    assert out["iwant"] == [b"\x03" * 20]
+    assert out["graft"] == ["t"]
+    assert out["prune"] == [("u", 60)]
+
+
+async def _mk_router(handler=None):
+    host = Libp2pHost()
+    gs = GossipSub(host)
+
+    async def validator(topic, raw, peer):
+        try:
+            return "accept", decompress(raw)
+        except Exception:
+            return "reject", b""
+
+    gs.set_validator(validator)
+    await host.listen()
+    await gs.subscribe(TOPIC, handler)
+    return host, gs
+
+
+def test_publish_delivers_across_three_nodes():
+    async def run():
+        got_b, got_c = [], []
+
+        async def on_b(ssz, peer):
+            got_b.append(ssz)
+
+        async def on_c(ssz, peer):
+            got_c.append(ssz)
+
+        ha, ga = await _mk_router()
+        hb, gb = await _mk_router(on_b)
+        hc, gc = await _mk_router(on_c)
+        # line topology a - b - c: c must receive via b's relay
+        await ha.connect("127.0.0.1", hb.listen_port)
+        await hb.connect("127.0.0.1", hc.listen_port)
+        await asyncio.sleep(0.3)  # subscription exchange
+        # form meshes deterministically instead of waiting for heartbeats
+        for g in (ga, gb, gc):
+            await g.heartbeat()
+        await asyncio.sleep(0.3)
+
+        n = await ga.publish(TOPIC, b"block-ssz-bytes")
+        assert n >= 1
+        for _ in range(40):
+            if got_b and got_c:
+                break
+            await asyncio.sleep(0.1)
+        assert got_b == [b"block-ssz-bytes"]
+        assert got_c == [b"block-ssz-bytes"], "relay through b must reach c"
+
+        # republish of the same bytes is seen-deduped at the source
+        assert await ga.publish(TOPIC, b"block-ssz-bytes") == 0
+
+        for h in (ha, hb, hc):
+            await h.close()
+
+    asyncio.run(run())
+
+
+def test_reject_penalizes_and_blocks_propagation():
+    async def run():
+        got_c = []
+
+        async def on_c(ssz, peer):
+            got_c.append(ssz)
+
+        ha, ga = await _mk_router()
+        hb, gb = await _mk_router()
+        hc, gc = await _mk_router(on_c)
+
+        async def reject_all(topic, raw, peer):
+            return "reject", b""
+
+        gb.set_validator(reject_all)
+        await ha.connect("127.0.0.1", hb.listen_port)
+        await hb.connect("127.0.0.1", hc.listen_port)
+        await asyncio.sleep(0.3)
+        for g in (ga, gb, gc):
+            await g.heartbeat()
+        await asyncio.sleep(0.2)
+
+        await ga.publish(TOPIC, b"invalid-payload")
+        await asyncio.sleep(0.5)
+        assert got_c == [], "rejected message must not propagate"
+        assert gb.metrics["rejected"] == 1
+        # the rejecting node penalized the sender
+        a_id = ha.peer_id
+        assert gb.scores[a_id].invalid > 0
+
+        for h in (ha, hb, hc):
+            await h.close()
+
+    asyncio.run(run())
+
+
+def test_iwant_serves_from_mcache():
+    async def run():
+        ha, ga = await _mk_router()
+        hb, gb = await _mk_router()
+        await ha.connect("127.0.0.1", hb.listen_port)
+        await asyncio.sleep(0.3)
+        for g in (ga, gb):
+            await g.heartbeat()
+
+        await ga.publish(TOPIC, b"payload-1")
+        await asyncio.sleep(0.3)
+        # b has the message cached; a direct IWANT from a's side gets it back
+        msg_id = next(iter(gb.mcache_index))
+        before = gb.metrics["iwant_served"]
+        await gb._on_iwant(ha.peer_id, [msg_id])
+        assert gb.metrics["iwant_served"] == before + 1
+
+        # mcache rotation expires entries after MCACHE_LEN heartbeats
+        for _ in range(gb.p.MCACHE_LEN + 1):
+            await gb.heartbeat()
+        assert msg_id not in gb.mcache_index
+
+        await ha.close()
+        await hb.close()
+
+    asyncio.run(run())
