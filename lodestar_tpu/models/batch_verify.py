@@ -60,7 +60,10 @@ COEFF_BITS = 64  # blinding scalar width, matches blst's 64-bit rand coeffs
 
 
 def _fp_to_mont_host(xs: list[int]) -> np.ndarray:
-    return np.asarray(fp.to_mont(fp.limbs_from_ints(xs)))
+    """Pure-numpy mont conversion: host prep must never bounce arrays
+    through the device (profiled: each jitted to_mont + pull-back through
+    the axon relay cost seconds and serialized the prep pipeline)."""
+    return np.stack([fp.mont_limbs_from_int(x) for x in xs])
 
 
 def _g1_batch_host(pts) -> tuple[np.ndarray, np.ndarray]:
@@ -71,9 +74,9 @@ def _g1_batch_host(pts) -> tuple[np.ndarray, np.ndarray]:
 
 
 def _g2_batch_host(pts) -> tuple[np.ndarray, np.ndarray]:
-    xs = tw.fp2_from_ints([p[0] for p in pts])
-    ys = tw.fp2_from_ints([p[1] for p in pts])
-    return np.asarray(xs), np.asarray(ys)
+    xs = np.stack([tw._fp2_mont_limbs_host(*p[0]) for p in pts])
+    ys = np.stack([tw._fp2_mont_limbs_host(*p[1]) for p in pts])
+    return xs, ys
 
 
 # device-constant: -g1 generator, mont form. Pure numpy — import of this
@@ -98,10 +101,28 @@ def prepare_sets(sets: list[SignatureSet]):
     None if any set is structurally invalid (decode failure, non-subgroup
     point, infinity pubkey/signature) — the fail-fast the oracle applies.
 
-    Arrays: pk (x, y), h (x, y), sig (x, y), valid_count.
+    Fast path: the native C++ library (lodestar_tpu/native/bls_host.cpp,
+    threaded, differential-tested in tests/native/test_bls_host.py) does
+    the whole decode+check+hash pipeline and emits device-layout limbs
+    directly. The pure-Python oracle path below is the fallback and the
+    correctness anchor.
+
+    Arrays: pk (x, y), h (x, y), sig (x, y).
     """
     if not sets:
         return None
+    from lodestar_tpu.native import bls as _nbls
+
+    if all(len(s.message) == 32 for s in sets):
+        native = _nbls.prepare_sets_native(
+            [bytes(s.pubkey) for s in sets],
+            [bytes(s.message) for s in sets],
+            [bytes(s.signature) for s in sets],
+        )
+        if native is not None:
+            return native
+        if _nbls.available():
+            return None  # native path loaded and REJECTED a set: fail fast
     pk_pts, h_pts, sig_pts = [], [], []
     try:
         for s in sets:
