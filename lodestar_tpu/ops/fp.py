@@ -201,67 +201,57 @@ def neg(a):
     return jnp.where(nz, _cond_sub_p(_carry_full(jnp.asarray(P_LIMBS) - a, passes=2)), a)
 
 
+# Band tensor for the variable-variable polynomial product: one dot
+# against a constant (1024, 64) one-hot map. A 32-term unrolled
+# shifted-FMA formulation was tried and measured runtime-IDENTICAL on the
+# chip while exploding XLA compile time ~5x (the pairing traces thousands
+# of convs; the r4 multichip-gate compile timed out) — the single-dot
+# form keeps graphs small.
+_T_BAND = np.zeros((LIMBS * LIMBS, 2 * LIMBS), dtype=np.int32)
+for _i in range(LIMBS):
+    for _j in range(LIMBS):
+        _T_BAND[_i * LIMBS + _j, _i + _j] = 1
+
+
+def _band_matrix(climbs, rows: int, cols: int) -> np.ndarray:
+    """Constant-operand conv as a matrix: out[k] = sum_i x[i]*c[k-i]
+    becomes x @ M with M[i, k] = c[k-i]."""
+    m = np.zeros((rows, cols), dtype=np.int32)
+    vals = [int(v) for v in climbs]
+    for i in range(rows):
+        for j, cj in enumerate(vals):
+            if i + j < cols:
+                m[i, i + j] = cj
+    return m
+
+
+_M_PPRIME_LOW = _band_matrix(PPRIME_LIMBS, LIMBS, LIMBS)  # product mod 2^384
+_M_P_FULL = _band_matrix(P_LIMBS, LIMBS, 2 * LIMBS)
+
+
 def _conv_pair(a, b):
-    """Polynomial product (.., 32) x (.., 32) -> (.., 64) as 32 shifted
-    fused multiply-adds.
-
-    This replaces the original outer-product + one-hot band-tensor matmul,
-    which materialized a (.., 32, 32) int32 accumulator and burned 64
-    redundant MACs per useful one — measured on the chip as the dominant
-    HBM traffic of the whole pairing. Here every term is an elementwise
-    mul + zero-pad that XLA fuses into a single kernel: the only arrays
-    that exist are the inputs and the (.., 64) output.
-
-    Coefficients <= 32 * (2^12-1)^2 < 2^29 (int32-safe).
-    """
-    pad_head = [(0, 0)] * (a.ndim - 1)
-    total = None
-    for j in range(LIMBS):
-        term = jnp.pad(a * b[..., j : j + 1], pad_head + [(j, LIMBS - j)])
-        total = term if total is None else total + term
-    return total
+    """Polynomial product (.., 32) x (.., 32) -> (.., 64) via the band
+    tensor. Coefficients <= 32 * (2^12-1)^2 < 2^29 (int32-safe)."""
+    outer = a[..., :, None] * b[..., None, :]
+    flat = outer.reshape(*outer.shape[:-2], LIMBS * LIMBS)
+    return flat @ jnp.asarray(_T_BAND)
 
 
 def _conv_sq(a):
-    """Polynomial square (.., 32) -> (.., 64): the j-th shifted row starts
-    at its diagonal term a_j^2 (counted once) followed by the doubled
-    cross terms 2*a_i*a_j for i > j — ~half the multiplies of _conv_pair.
-    Same < 2^29 coefficient bound (the double counts ordered pairs)."""
-    pad_head = [(0, 0)] * (a.ndim - 1)
-    total = None
-    for j in range(LIMBS):
-        row = a[..., j:] * a[..., j : j + 1]
-        row = jnp.concatenate([row[..., :1], row[..., 1:] + row[..., 1:]], axis=-1)
-        term = jnp.pad(row, pad_head + [(2 * j, LIMBS - j)])
-        total = term if total is None else total + term
-    return total
+    """Polynomial square — same band form (the halved-multiply shifted
+    variant measured no faster on chip; see _conv_pair note)."""
+    return _conv_pair(a, a)
 
 
-def _conv_const_low(x, climbs) -> jax.Array:
-    """First 32 coefficients of x * const (triangular conv, i.e. the
-    product mod 2^384). climbs: host numpy 12-bit limbs; zero limbs cost
-    nothing. x limbs <= 2^12 -> coefficients < 2^29."""
-    pad_head = [(0, 0)] * (x.ndim - 1)
-    total = None
-    for j, cj in enumerate(int(v) for v in climbs):
-        if cj == 0:
-            continue
-        term = jnp.pad(x[..., : LIMBS - j] * cj, pad_head + [(j, 0)])
-        total = term if total is None else total + term
-    return total
+def _conv_pprime_low(x) -> jax.Array:
+    """First 32 coefficients of x * P' (the product mod 2^384) as one
+    (.., 32) @ (32, 32) dot. x limbs <= 2^12 -> coefficients < 2^29."""
+    return x @ jnp.asarray(_M_PPRIME_LOW)
 
 
-def _conv_const_full(x, climbs) -> jax.Array:
-    """Full product x * const as (.., 64) coefficients. x limbs <= 2^12 ->
-    coefficients < 2^29."""
-    pad_head = [(0, 0)] * (x.ndim - 1)
-    total = None
-    for j, cj in enumerate(int(v) for v in climbs):
-        if cj == 0:
-            continue
-        term = jnp.pad(x * cj, pad_head + [(j, LIMBS - j)])
-        total = term if total is None else total + term
-    return total
+def _conv_p_full(x) -> jax.Array:
+    """Full product x * p as (.., 64) coefficients via one dot."""
+    return x @ jnp.asarray(_M_P_FULL)
 
 
 def _carry3(x):
@@ -289,8 +279,8 @@ def _mont_redc(t):
     2^384 — the carry is just the batch predicate any(s_lo != 0). No
     sequential scan anywhere in the reduction.
     """
-    m = _carry3(_conv_const_low(t[..., :LIMBS], PPRIME_LIMBS))  # mod 2^384
-    s = _carry3(t + _conv_const_full(m, P_LIMBS))
+    m = _carry3(_conv_pprime_low(t[..., :LIMBS]))  # mod 2^384
+    s = _carry3(t + _conv_p_full(m))
     carry = jnp.any(s[..., :LIMBS] != 0, axis=-1)
     hi = s[..., LIMBS:]
     hi0 = hi[..., :1] + carry[..., None].astype(jnp.int32)
@@ -308,7 +298,8 @@ def mont_mul(a, b):
 
 @jax.jit
 def mont_sq(a):
-    """Montgomery square — dedicated halved-conv path (see _conv_sq)."""
+    """Montgomery square (same conv as mont_mul — a halved-multiply
+    shifted formulation measured no faster on chip)."""
     return _mont_redc(_carry3(_conv_sq(a)))
 
 

@@ -1,0 +1,161 @@
+"""Pallas TPU kernels for the Fp core: fused Montgomery multiply.
+
+Why: profiled on the chip (tools/kernel_microbench.py), the XLA-op
+formulation of `mont_mul` runs at ~9.3 ms per (221k, 32) call — ~40 GB/s
+effective, nowhere near the VPU or HBM — because every conv and carry
+pass is a separate HBM round-trip. This kernel keeps the whole
+multiply (three convolutions + carry normalization + the separated
+Montgomery reduction + canonicalization) in VMEM: per call the only HBM
+traffic is reading a, b and writing the result.
+
+Layout: batch on sublanes, limbs on lanes. Shifted-window trick for the
+convolutions: operands are placed in the middle of a 128-lane scratch
+row, so `buf[:, 64-j : 128-j]` IS the operand shifted right by j limbs —
+static lane slices, no rolls, no gathers.
+
+Selected via LODESTAR_FP_PALLAS=1 (fp.mont_mul/mont_sq dispatch here on
+TPU backends); tests/ops/test_fp_pallas.py pins it against the XLA path
+in interpret mode, and the standard differential suite covers the whole
+pairing when the flag is on.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import fp
+
+BLOCK = 1024  # batch rows per grid step (sublanes)
+LANES = 128  # scratch row width; operands live in lanes 64..95
+
+_PP = [int(v) for v in fp.PPRIME_LIMBS]  # P' limbs (scalar constants)
+_PL = [int(v) for v in fp.P_LIMBS]  # p limbs
+
+
+def _mont_mul_kernel(a_ref, b_ref, o_ref, pad_ref, acc_ref, m_ref):
+    """o = mont_mul(a, b) for one (BLOCK, 32) block."""
+    zeros_pad = jnp.zeros((BLOCK, LANES), jnp.int32)
+
+    def windows(x32):
+        """Place x (BLOCK, 32) at lanes 64..95 of the scratch; window(j)
+        = lanes [64-j, 128-j) = x shifted right by j limbs (64 wide)."""
+        pad_ref[:] = zeros_pad
+        pad_ref[:, 64:96] = x32
+        return [pad_ref[:, 64 - j : 128 - j] for j in range(32)]
+
+    # --- t = a * b (poly conv, 64 coeffs, <= 2^29) -------------------------
+    a = a_ref[:]
+    b = b_ref[:]
+    acc = jnp.zeros((BLOCK, 64), jnp.int32)
+    wins = windows(a)
+    for j in range(32):
+        acc = acc + wins[j] * b[:, j : j + 1]
+
+    # --- 3 parallel carry passes -> limbs <= 2^12 --------------------------
+    def carry_pass(x, width):
+        c = x >> 12
+        lo = x & 0xFFF
+        pad_ref[:] = zeros_pad
+        pad_ref[:, 64 : 64 + width] = c
+        shifted = pad_ref[:, 63 : 63 + width]
+        return lo + shifted
+
+    for _ in range(3):
+        acc = carry_pass(acc, 64)
+    acc_ref[:, :64] = acc
+
+    # --- m = t_lo * P' mod 2^384 (triangular conv) -------------------------
+    t_lo = acc_ref[:, :32]
+    m = jnp.zeros((BLOCK, 32), jnp.int32)
+    wins = windows(t_lo)
+    for j in range(32):
+        cj = _PP[j]
+        if cj:
+            m = m + wins[j][:, :32] * cj
+    for _ in range(3):
+        m = carry_pass(m, 32)
+    m_ref[:, :32] = m
+
+    # --- s = t + m * p ------------------------------------------------------
+    s = acc_ref[:, :64]
+    wins = windows(m_ref[:, :32])
+    for j in range(32):
+        cj = _PL[j]
+        if cj:
+            s = s + wins[j] * cj
+    for _ in range(3):
+        s = carry_pass(s, 64)
+
+    # low half is 0 or exactly 2^384: carry = any(s_lo != 0)
+    carry = jnp.any(s[:, :32] != 0, axis=-1, keepdims=True).astype(jnp.int32)
+    hi = s[:, 32:]
+    hi = jnp.concatenate([hi[:, :1] + carry, hi[:, 1:]], axis=-1)
+
+    # --- exact carry + conditional subtract (canonical contract) -----------
+    # limbs <= 2^12 + 1; one sequential pass over 32 lanes, statically
+    # unrolled (static slices + Python-constant p limbs — Pallas kernels
+    # must not capture traced constant arrays)
+    cols = []
+    c = jnp.zeros((BLOCK, 1), jnp.int32)
+    for i in range(32):
+        col = hi[:, i : i + 1] + c
+        c = col >> 12
+        cols.append(col & 0xFFF)
+    hi = jnp.concatenate(cols, axis=-1)
+
+    # borrow chain for x - p
+    subs = []
+    brw = jnp.zeros((BLOCK, 1), jnp.int32)
+    for i in range(32):
+        d = hi[:, i : i + 1] - _PL[i] - brw
+        brw = (d < 0).astype(jnp.int32)
+        subs.append(d + (brw << 12))
+    sub = jnp.concatenate(subs, axis=-1)
+    ge = brw == 0
+    o_ref[:] = jnp.where(ge, sub, hi)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _mont_mul_flat(a, b, interpret=False):
+    """(N, 32) x (N, 32) -> (N, 32); N must be a BLOCK multiple."""
+    n = a.shape[0]
+    grid = (n // BLOCK,)
+    spec = pl.BlockSpec((BLOCK, 32), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _mont_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 32), jnp.int32),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, LANES), jnp.int32),
+            pltpu.VMEM((BLOCK, 64), jnp.int32),
+            pltpu.VMEM((BLOCK, 32), jnp.int32),
+        ],
+        interpret=interpret,
+    )(a, b)
+
+
+def mont_mul(a, b, *, interpret: bool = False):
+    """Drop-in mont_mul over arbitrary leading batch dims."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape).reshape(-1, 32)
+    b = jnp.broadcast_to(b, shape).reshape(-1, 32)
+    n = a.shape[0]
+    padded = (n + BLOCK - 1) // BLOCK * BLOCK
+    if padded != n:
+        pad = [(0, padded - n), (0, 0)]
+        a = jnp.pad(a, pad)
+        b = jnp.pad(b, pad)
+    out = _mont_mul_flat(a, b, interpret=interpret)
+    return out[:n].reshape(shape)
+
+
+def mont_sq(a, *, interpret: bool = False):
+    return mont_mul(a, a, interpret=interpret)

@@ -1,13 +1,11 @@
 """Microbenchmark fp-kernel variants on the real device.
 
 Measures, at the batch-verify operating shape (~221k field elements),
-the per-call time of:
-  * the live mont_mul / add / carry primitives
-  * alternative conv formulations (band-matmul, stacked-pad sum)
-  * a scan-free "lazy" mont_mul prototype (no exact carry, no cond-sub)
-Prints one line per variant: name, ms/call, implied GB/s of array traffic.
+chained invocations of each variant (k per launch, so per-call cost is
+dispatch-amortized), syncing on a scalar device->host transfer — 
+block_until_ready does NOT reliably wait through the axon relay.
 
-Run: python tools/kernel_microbench.py [batch]
+Run: python tools/kernel_microbench.py [batch] [chain]
 """
 
 import sys
@@ -26,6 +24,7 @@ from lodestar_tpu.utils import enable_compile_cache
 enable_compile_cache(".")
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096 * 54
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 16
 
 rng = np.random.default_rng(0)
 
@@ -38,57 +37,37 @@ def rand_fp(n):
 a = rand_fp(B)
 b = rand_fp(B)
 
-
-def timeit(name, fn, *args, iters=10, passes_bytes=None):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
-    gbps = (passes_bytes / dt / 1e9) if passes_bytes else 0.0
-    print(f"{name:34s} {dt*1e3:9.3f} ms   {gbps:7.1f} GB/s(min-traffic)", flush=True)
-    return dt
-
-
 ARR = B * 32 * 4  # one (B, 32) int32 pass
 
 
-# --- live primitives ---------------------------------------------------------
+def chained(op):
+    @jax.jit
+    def f(x, y):
+        for _ in range(K):
+            x = op(x, y)
+        return x[0, :1]  # tiny output: the sync point
 
-timeit("mont_mul (live)", fp.mont_mul, a, b, passes_bytes=3 * ARR)
-timeit("mont_sq (live)", fp.mont_sq, a, passes_bytes=2 * ARR)
-timeit("add (live)", fp.add, a, b, passes_bytes=3 * ARR)
-
-
-@jax.jit
-def carry_seq_only(x):
-    return fp._carry_seq(x)
+    return f
 
 
-@jax.jit
-def cond_sub_only(x):
-    return fp._cond_sub_p(x)
+def timeit(name, op, iters=3, passes_per_call=3):
+    f = chained(op)
+    np.asarray(f(a, b))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = np.asarray(f(a, b))
+    dt = (time.perf_counter() - t0) / iters / K
+    gbps = passes_per_call * ARR / dt / 1e9
+    print(f"{name:34s} {dt*1e3:9.3f} ms/call  {gbps:7.1f} GB/s(min)", flush=True)
+    return dt
 
 
-@jax.jit
-def carry3_only(x):
-    return fp._carry3(jnp.pad(x, [(0, 0), (0, fp.LIMBS)]))
-
-
-timeit("_carry_seq alone", carry_seq_only, a, passes_bytes=2 * ARR)
-timeit("_cond_sub_p alone", cond_sub_only, a, passes_bytes=2 * ARR)
-timeit("_carry3 (64-wide) alone", carry3_only, a, passes_bytes=4 * ARR)
-
-
-# --- conv variants -----------------------------------------------------------
-
-
-@jax.jit
-def conv_shift(a, b):
-    return fp._conv_pair(a, b)
-
+timeit("mont_mul (live)", fp.mont_mul)
+timeit("mont_sq (live)", lambda x, y: fp.mont_sq(x))
+timeit("add (live)", fp.add)
+timeit("_carry_seq", lambda x, y: fp._carry_seq(x + y), passes_per_call=2)
+timeit("_cond_sub_p", lambda x, y: fp._cond_sub_p(jnp.clip(x + y, 0, 4095)), passes_per_call=2)
+timeit("_carry3(64)", lambda x, y: fp._carry3(jnp.concatenate([x, y], -1))[..., :32], passes_per_call=4)
 
 _T = np.zeros((fp.LIMBS * fp.LIMBS, 2 * fp.LIMBS), dtype=np.int32)
 for i in range(fp.LIMBS):
@@ -96,64 +75,45 @@ for i in range(fp.LIMBS):
         _T[i * fp.LIMBS + j, i + j] = 1
 
 
-@jax.jit
-def conv_bandmatmul(a, b):
-    outer = a[..., :, None] * b[..., None, :]
+def conv_band(x, y):
+    outer = x[..., :, None] * y[..., None, :]
     flat = outer.reshape(*outer.shape[:-2], fp.LIMBS * fp.LIMBS)
-    return flat @ jnp.asarray(_T)
+    return (flat @ jnp.asarray(_T))[..., :32]
 
 
-@jax.jit
-def conv_stacksum(a, b):
+def conv_shift(x, y):
+    # true 32-term shifted-FMA formulation (fp._conv_pair is now the band
+    # matmul; this keeps the alternative measurable)
+    total = None
+    for j in range(32):
+        term = jnp.pad(x * y[:, j : j + 1], [(0, 0), (j, 32 - j)])
+        total = term if total is None else total + term
+    return total[..., :32]
+
+
+def conv_stacksum(x, y):
     terms = [
-        jnp.pad(a * b[..., j : j + 1], [(0, 0), (j, fp.LIMBS - j)])
+        jnp.pad(x * y[..., j : j + 1], [(0, 0), (j, fp.LIMBS - j)])
         for j in range(fp.LIMBS)
     ]
-    return jnp.sum(jnp.stack(terms, 0), 0)
+    return jnp.sum(jnp.stack(terms, 0), 0)[..., :32]
 
 
-timeit("conv: shifted-FMA chain (live)", conv_shift, a, b, passes_bytes=4 * ARR)
-timeit("conv: outer+band matmul (old)", conv_bandmatmul, a, b, passes_bytes=4 * ARR)
-timeit("conv: stack+sum", conv_stacksum, a, b, passes_bytes=4 * ARR)
+timeit("conv shifted-FMA (live)", conv_shift, passes_per_call=4)
+timeit("conv outer+band matmul (old)", conv_band, passes_per_call=4)
+timeit("conv stack+sum", conv_stacksum, passes_per_call=4)
 
 
-# --- lazy mont_mul prototype (no scans, no cond-sub) -------------------------
-
-
-@jax.jit
-def mont_mul_lazy(a, b):
-    t = fp._carry_once(fp._carry_once(fp._conv_pair(a, b)))
-    m = fp._carry_once(fp._carry_once(fp._conv_const_low(t[..., : fp.LIMBS], fp.PPRIME_LIMBS)))
-    s = fp._carry_once(fp._carry_once(t + fp._conv_const_full(m, fp.P_LIMBS)))
+def mont_mul_lazy(x, y):
+    t = fp._carry_once(fp._carry_once(fp._conv_pair(x, y)))
+    m = fp._carry_once(fp._carry_once(fp._conv_pprime_low(t[..., : fp.LIMBS])))
+    s = fp._carry_once(fp._carry_once(t + fp._conv_p_full(m)))
     carry = jnp.any(s[..., : fp.LIMBS] != 0, axis=-1)
     hi = s[..., fp.LIMBS :]
     hi0 = hi[..., :1] + carry[..., None].astype(jnp.int32)
     return jnp.concatenate([hi0, hi[..., 1:]], axis=-1)
 
 
-timeit("mont_mul LAZY prototype", mont_mul_lazy, a, b, passes_bytes=3 * ARR)
-
-
-# --- chained composition (amortization check) --------------------------------
-
-
-@jax.jit
-def chain8_live(a, b):
-    x = a
-    for _ in range(8):
-        x = fp.mont_mul(x, b)
-    return x
-
-
-@jax.jit
-def chain8_lazy(a, b):
-    x = a
-    for _ in range(8):
-        x = mont_mul_lazy(x, b)
-    return x
-
-
-timeit("8-chain live mont_mul", chain8_live, a, b, iters=5, passes_bytes=24 * ARR)
-timeit("8-chain LAZY mont_mul", chain8_lazy, a, b, iters=5, passes_bytes=24 * ARR)
+timeit("mont_mul LAZY (no scans)", mont_mul_lazy)
 
 print("done", flush=True)

@@ -1,0 +1,34 @@
+"""Pallas vs XLA mont_mul on the real device (chained, RTT-amortized)."""
+import sys, time
+import numpy as np
+sys.path.insert(0, ".")
+import jax, jax.numpy as jnp
+from lodestar_tpu.ops import fp, fp_pallas
+from lodestar_tpu.utils import enable_compile_cache
+enable_compile_cache(".")
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096 * 54
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+rng = np.random.default_rng(0)
+vals = lambda n: [int.from_bytes(rng.bytes(47), "big") % fp.P for _ in range(n)]
+a = fp.to_mont(fp.limbs_from_ints(vals(B)))
+b = fp.to_mont(fp.limbs_from_ints(vals(B)))
+
+def bench(name, op):
+    @jax.jit
+    def f(x, y):
+        for _ in range(K):
+            x = op(x, y)
+        return x[0, :1]
+    np.asarray(f(a, b))
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        out = np.asarray(f(a, b))
+    dt = (time.perf_counter() - t0) / iters / K
+    print(f"{name:28s} {dt*1e3:8.3f} ms/call", flush=True)
+    return out
+
+o1 = bench("mont_mul XLA", fp.mont_mul)
+o2 = bench("mont_mul PALLAS", lambda x, y: fp_pallas.mont_mul(x, y))
+print("agree:", bool((o1 == o2).all()), flush=True)
