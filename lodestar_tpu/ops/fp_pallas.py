@@ -31,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import fp
 
-BLOCK = 1024  # batch rows per grid step (sublanes)
+BLOCK = 256  # batch rows per grid step (sublanes; VMEM-budget bound)
 LANES = 128  # scratch row width; operands live in lanes 64..95
 
 _PP = [int(v) for v in fp.PPRIME_LIMBS]  # P' limbs (scalar constants)
@@ -42,20 +42,20 @@ def _mont_mul_kernel(a_ref, b_ref, o_ref, pad_ref, acc_ref, m_ref):
     """o = mont_mul(a, b) for one (BLOCK, 32) block."""
     zeros_pad = jnp.zeros((BLOCK, LANES), jnp.int32)
 
-    def windows(x32):
-        """Place x (BLOCK, 32) at lanes 64..95 of the scratch; window(j)
-        = lanes [64-j, 128-j) = x shifted right by j limbs (64 wide)."""
+    def load_operand(x32):
+        """Place x (BLOCK, 32) at lanes 64..95 of the scratch; a later
+        `pad_ref[:, 64-j : 128-j]` read IS x shifted right by j limbs
+        (64 wide). Windows are read lazily inside the loops so at most
+        one is live at a time (VMEM budget)."""
         pad_ref[:] = zeros_pad
         pad_ref[:, 64:96] = x32
-        return [pad_ref[:, 64 - j : 128 - j] for j in range(32)]
 
     # --- t = a * b (poly conv, 64 coeffs, <= 2^29) -------------------------
-    a = a_ref[:]
     b = b_ref[:]
     acc = jnp.zeros((BLOCK, 64), jnp.int32)
-    wins = windows(a)
+    load_operand(a_ref[:])
     for j in range(32):
-        acc = acc + wins[j] * b[:, j : j + 1]
+        acc = acc + pad_ref[:, 64 - j : 128 - j] * b[:, j : j + 1]
 
     # --- 3 parallel carry passes -> limbs <= 2^12 --------------------------
     def carry_pass(x, width):
@@ -71,24 +71,23 @@ def _mont_mul_kernel(a_ref, b_ref, o_ref, pad_ref, acc_ref, m_ref):
     acc_ref[:, :64] = acc
 
     # --- m = t_lo * P' mod 2^384 (triangular conv) -------------------------
-    t_lo = acc_ref[:, :32]
     m = jnp.zeros((BLOCK, 32), jnp.int32)
-    wins = windows(t_lo)
+    load_operand(acc_ref[:, :32])
     for j in range(32):
         cj = _PP[j]
         if cj:
-            m = m + wins[j][:, :32] * cj
+            m = m + pad_ref[:, 64 - j : 96 - j] * cj
     for _ in range(3):
         m = carry_pass(m, 32)
     m_ref[:, :32] = m
 
     # --- s = t + m * p ------------------------------------------------------
     s = acc_ref[:, :64]
-    wins = windows(m_ref[:, :32])
+    load_operand(m_ref[:, :32])
     for j in range(32):
         cj = _PL[j]
         if cj:
-            s = s + wins[j] * cj
+            s = s + pad_ref[:, 64 - j : 128 - j] * cj
     for _ in range(3):
         s = carry_pass(s, 64)
 
