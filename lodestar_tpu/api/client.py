@@ -90,6 +90,36 @@ class BeaconApiClient:
         )
 
     # node
+    # sync-committee validator flows
+    def get_sync_committee_duties(self, epoch: int, indices: list[int]):
+        return self._req(
+            "POST", f"/eth/v1/validator/duties/sync/{epoch}", body=[int(i) for i in indices]
+        )
+
+    def submit_pool_sync_committees(self, messages_json: list):
+        return self._req("POST", "/eth/v1/beacon/pool/sync_committees", body=messages_json)
+
+    def produce_sync_committee_contribution(
+        self, slot: int, subcommittee_index: int, beacon_block_root: str
+    ):
+        return self._req(
+            "GET",
+            "/eth/v1/validator/sync_committee_contribution",
+            {
+                "slot": str(slot),
+                "subcommittee_index": str(subcommittee_index),
+                "beacon_block_root": beacon_block_root,
+            },
+        )
+
+    def publish_contribution_and_proofs(self, signed_json: list):
+        return self._req(
+            "POST", "/eth/v1/validator/contribution_and_proofs", body=signed_json
+        )
+
+    def get_block_root(self, block_id: str):
+        return self._req("GET", f"/eth/v1/beacon/blocks/{block_id}/root")
+
     def get_health(self) -> int:
         try:
             self._req("GET", "/eth/v1/node/health")

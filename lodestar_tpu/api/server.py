@@ -20,24 +20,65 @@ __all__ = ["BeaconRestApiServer", "ROUTES"]
 # (method, path regex with named groups, handler name, kind)
 ROUTES: list[tuple[str, str, str]] = [
     ("GET", r"/eth/v1/beacon/genesis", "r_genesis"),
+    ("GET", r"/eth/v1/beacon/headers", "r_block_headers"),
     ("GET", r"/eth/v1/beacon/headers/(?P<block_id>[^/]+)", "r_block_header"),
     ("GET", r"/eth/v2/beacon/blocks/(?P<block_id>[^/]+)", "r_block_v2"),
+    ("GET", r"/eth/v1/beacon/blocks/(?P<block_id>[^/]+)/root", "r_block_root"),
+    ("GET", r"/eth/v1/beacon/blocks/(?P<block_id>[^/]+)/attestations", "r_block_attestations"),
     ("POST", r"/eth/v1/beacon/blocks", "r_publish_block"),
     ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/finality_checkpoints", "r_finality"),
     ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/fork", "r_fork"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/root", "r_state_root"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/committees", "r_committees"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/sync_committees", "r_sync_committees"),
     ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators", "r_validators"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators/(?P<validator_id>[^/]+)", "r_state_validator"),
+    ("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/validator_balances", "r_validator_balances"),
+    ("GET", r"/eth/v1/beacon/pool/attestations", "r_get_pool_attestations"),
     ("POST", r"/eth/v1/beacon/pool/attestations", "r_pool_attestations"),
+    ("GET", r"/eth/v1/beacon/pool/attester_slashings", "r_get_pool_attester_slashings"),
+    ("POST", r"/eth/v1/beacon/pool/attester_slashings", "r_post_pool_attester_slashing"),
+    ("GET", r"/eth/v1/beacon/pool/proposer_slashings", "r_get_pool_proposer_slashings"),
+    ("POST", r"/eth/v1/beacon/pool/proposer_slashings", "r_post_pool_proposer_slashing"),
+    ("GET", r"/eth/v1/beacon/pool/voluntary_exits", "r_get_pool_voluntary_exits"),
+    ("POST", r"/eth/v1/beacon/pool/voluntary_exits", "r_post_pool_voluntary_exit"),
+    ("GET", r"/eth/v1/beacon/pool/bls_to_execution_changes", "r_get_pool_bls_changes"),
+    ("POST", r"/eth/v1/beacon/pool/bls_to_execution_changes", "r_post_pool_bls_change"),
+    ("POST", r"/eth/v1/beacon/pool/sync_committees", "r_post_pool_sync_committees"),
+    ("GET", r"/eth/v1/beacon/light_client/bootstrap/(?P<block_root>0x[0-9a-fA-F]+)", "r_lc_bootstrap"),
+    ("GET", r"/eth/v1/beacon/light_client/updates", "r_lc_updates"),
+    ("GET", r"/eth/v1/beacon/light_client/optimistic_update", "r_lc_optimistic"),
+    ("GET", r"/eth/v1/beacon/light_client/finality_update", "r_lc_finality"),
+    ("GET", r"/eth/v0/beacon/proof/state/(?P<state_id>[^/]+)", "r_state_proof"),
     ("GET", r"/eth/v1/validator/duties/proposer/(?P<epoch>\d+)", "r_proposer_duties"),
     ("POST", r"/eth/v1/validator/duties/attester/(?P<epoch>\d+)", "r_attester_duties"),
+    ("POST", r"/eth/v1/validator/duties/sync/(?P<epoch>\d+)", "r_sync_duties"),
     ("GET", r"/eth/v2/validator/blocks/(?P<slot>\d+)", "r_produce_block"),
     ("GET", r"/eth/v1/validator/attestation_data", "r_attestation_data"),
+    ("GET", r"/eth/v1/validator/aggregate_attestation", "r_aggregate_attestation"),
+    ("POST", r"/eth/v1/validator/aggregate_and_proofs", "r_aggregate_and_proofs"),
+    ("GET", r"/eth/v1/validator/sync_committee_contribution", "r_sync_contribution"),
+    ("POST", r"/eth/v1/validator/contribution_and_proofs", "r_contribution_and_proofs"),
+    ("POST", r"/eth/v1/validator/beacon_committee_subscriptions", "r_committee_subscriptions"),
+    ("POST", r"/eth/v1/validator/sync_committee_subscriptions", "r_sync_subscriptions"),
+    ("POST", r"/eth/v1/validator/prepare_beacon_proposer", "r_prepare_proposer"),
+    ("POST", r"/eth/v1/validator/register_validator", "r_register_validator"),
     ("POST", r"/eth/v1/validator/liveness/(?P<epoch>\d+)", "r_liveness"),
     ("GET", r"/eth/v1/events", "r_events"),
     ("GET", r"/eth/v1/node/health", "r_health"),
     ("GET", r"/eth/v1/node/version", "r_version"),
     ("GET", r"/eth/v1/node/syncing", "r_syncing"),
+    ("GET", r"/eth/v1/node/identity", "r_node_identity"),
+    ("GET", r"/eth/v1/node/peers", "r_node_peers"),
+    ("GET", r"/eth/v1/node/peers/(?P<peer_id>[^/]+)", "r_node_peer"),
+    ("GET", r"/eth/v1/node/peer_count", "r_node_peer_count"),
     ("GET", r"/eth/v2/debug/beacon/states/(?P<state_id>[^/]+)", "r_debug_state"),
+    ("GET", r"/eth/v1/debug/beacon/heads", "r_debug_heads"),
+    ("GET", r"/eth/v2/debug/beacon/heads", "r_debug_heads"),
+    ("GET", r"/eth/v0/debug/forkchoice", "r_debug_forkchoice"),
     ("GET", r"/eth/v1/config/spec", "r_spec"),
+    ("GET", r"/eth/v1/config/fork_schedule", "r_fork_schedule"),
+    ("GET", r"/eth/v1/config/deposit_contract", "r_deposit_contract"),
 ]
 
 
@@ -121,6 +162,129 @@ class _Router:
 
     def r_spec(self, **kw):
         return self.api.get_spec()
+
+    # -- expanded surface (beacon/state, pools, node, lightclient, proof,
+    # sync-committee validator flows, debug, config) --------------------------
+
+    def r_block_headers(self, query, **kw):
+        return self.api.get_block_headers(query)
+
+    def r_block_root(self, block_id, **kw):
+        return self.api.get_block_root(block_id)
+
+    def r_block_attestations(self, block_id, **kw):
+        return self.api.get_block_attestations(block_id)
+
+    def r_state_root(self, state_id, **kw):
+        return self.api.get_state_root(state_id)
+
+    def r_committees(self, state_id, query, **kw):
+        return self.api.get_epoch_committees(state_id, query)
+
+    def r_sync_committees(self, state_id, query, **kw):
+        return self.api.get_epoch_sync_committees(state_id, query)
+
+    def r_state_validator(self, state_id, validator_id, **kw):
+        return self.api.get_state_validator(state_id, validator_id)
+
+    def r_validator_balances(self, state_id, query, **kw):
+        return self.api.get_state_validator_balances(state_id, query)
+
+    def r_get_pool_attestations(self, **kw):
+        return self.api.get_pool_attestations()
+
+    def r_get_pool_attester_slashings(self, **kw):
+        return self.api.get_pool_attester_slashings()
+
+    def r_post_pool_attester_slashing(self, body, **kw):
+        return self.api.submit_pool_attester_slashing(body)
+
+    def r_get_pool_proposer_slashings(self, **kw):
+        return self.api.get_pool_proposer_slashings()
+
+    def r_post_pool_proposer_slashing(self, body, **kw):
+        return self.api.submit_pool_proposer_slashing(body)
+
+    def r_get_pool_voluntary_exits(self, **kw):
+        return self.api.get_pool_voluntary_exits()
+
+    def r_post_pool_voluntary_exit(self, body, **kw):
+        return self.api.submit_pool_voluntary_exit(body)
+
+    def r_get_pool_bls_changes(self, **kw):
+        return self.api.get_pool_bls_changes()
+
+    def r_post_pool_bls_change(self, body, **kw):
+        return self.api.submit_pool_bls_change(body)
+
+    def r_post_pool_sync_committees(self, body, **kw):
+        return self.api.submit_pool_sync_committees(body or [])
+
+    def r_lc_bootstrap(self, block_root, **kw):
+        return self.api.get_lc_bootstrap(block_root)
+
+    def r_lc_updates(self, query, **kw):
+        return self.api.get_lc_updates(query)
+
+    def r_lc_optimistic(self, **kw):
+        return self.api.get_lc_optimistic_update()
+
+    def r_lc_finality(self, **kw):
+        return self.api.get_lc_finality_update()
+
+    def r_state_proof(self, state_id, query, **kw):
+        return self.api.get_state_proof(state_id, query)
+
+    def r_sync_duties(self, epoch, body, **kw):
+        return self.api.get_sync_committee_duties(int(epoch), [int(i) for i in (body or [])])
+
+    def r_aggregate_attestation(self, query, **kw):
+        return self.api.get_aggregated_attestation(query)
+
+    def r_aggregate_and_proofs(self, body, **kw):
+        return self.api.publish_aggregate_and_proofs(body or [])
+
+    def r_sync_contribution(self, query, **kw):
+        return self.api.produce_sync_committee_contribution(query)
+
+    def r_contribution_and_proofs(self, body, **kw):
+        return self.api.publish_contribution_and_proofs(body or [])
+
+    def r_committee_subscriptions(self, body, **kw):
+        return self.api.prepare_beacon_committee_subnet(body or [])
+
+    def r_sync_subscriptions(self, body, **kw):
+        return self.api.prepare_sync_committee_subnets(body or [])
+
+    def r_prepare_proposer(self, body, **kw):
+        return self.api.prepare_beacon_proposer(body or [])
+
+    def r_register_validator(self, body, **kw):
+        return self.api.register_validator(body or [])
+
+    def r_node_identity(self, **kw):
+        return self.api.get_node_identity()
+
+    def r_node_peers(self, query, **kw):
+        return self.api.get_node_peers(query)
+
+    def r_node_peer(self, peer_id, **kw):
+        return self.api.get_node_peer(peer_id)
+
+    def r_node_peer_count(self, **kw):
+        return self.api.get_node_peer_count()
+
+    def r_debug_heads(self, **kw):
+        return self.api.get_debug_chain_heads()
+
+    def r_debug_forkchoice(self, **kw):
+        return self.api.get_fork_choice_nodes()
+
+    def r_fork_schedule(self, **kw):
+        return self.api.get_fork_schedule()
+
+    def r_deposit_contract(self, **kw):
+        return self.api.get_deposit_contract()
 
 
 class RestServer:

@@ -96,6 +96,7 @@ class Libp2pBeaconNetwork:
         self.bootnodes = list(bootnodes or [])
         self.subscribe_subnets = subscribe_subnets
         self.log = get_logger(name="lodestar.network")
+        chain.network = self  # node/api surfaces (node identity, peers) read this
         self._digest_to_fork: dict[bytes, str] = {}
         self.gossip.set_validator(self._validate_gossip)
         self.host.on_peer_connect = self._on_peer_connect

@@ -137,4 +137,89 @@ class RestValidator:
                 [to_json(t.Attestation, a) for a in to_submit]
             )
         out["attestations"] = to_submit
+
+        # -- sync-committee duties over REST (services/syncCommittee.ts) --
+        out["sync_messages"], out["sync_contributions"] = self._run_sync_duties_rest(
+            slot, epoch, t
+        )
         return out
+
+    def _run_sync_duties_rest(self, slot: int, epoch: int, t) -> tuple[list, list]:
+        """Sync-committee message + contribution flow entirely over the
+        Beacon API (duties/sync, pool/sync_committees,
+        sync_committee_contribution, contribution_and_proofs) — no
+        in-process chain access."""
+        from lodestar_tpu.chain.validation import is_sync_committee_aggregator
+        from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_COUNT
+
+        p = self.p
+        try:
+            duties = self.client.get_sync_committee_duties(
+                epoch, sorted(self._index_to_pubkey)
+            ).get("data", [])
+        except Exception as e:
+            self.log.warning("sync duties fetch failed: %s", e)
+            return [], []
+        if not duties:
+            return [], []
+        head_root = self.client.get_block_root("head")["data"]["root"]
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+
+        messages, contributions = [], []
+        msg_jsons = []
+        for duty in duties:
+            pk = bytes.fromhex(duty["pubkey"][2:])
+            if not self._may_sign(pk):
+                continue
+            msg = t.SyncCommitteeMessage.default()
+            msg.slot = slot
+            msg.beacon_block_root = bytes.fromhex(head_root[2:])
+            msg.validator_index = int(duty["validator_index"])
+            try:
+                msg.signature = self.store.sign_sync_committee_message(
+                    pk, slot, bytes(msg.beacon_block_root)
+                )
+            except ValueError:
+                continue  # key removed concurrently
+            msg_jsons.append(to_json(t.SyncCommitteeMessage, msg))
+            messages.append(msg)
+        if msg_jsons:
+            try:
+                self.client.submit_pool_sync_committees(msg_jsons)
+            except Exception as e:
+                self.log.warning("sync message submit failed: %s", e)
+
+        for duty in duties:
+            pk = bytes.fromhex(duty["pubkey"][2:])
+            if not self._may_sign(pk):
+                continue
+            for pos_str in duty.get("validator_sync_committee_indices", []):
+                subnet = int(pos_str) // sub_size
+                try:
+                    proof = self.store.sign_sync_selection_proof(pk, slot, subnet)
+                except ValueError:
+                    continue
+                if not is_sync_committee_aggregator(proof, p):
+                    continue
+                try:
+                    res = self.client.produce_sync_committee_contribution(
+                        slot, subnet, head_root
+                    )
+                except Exception:
+                    continue  # no contribution available yet
+                contribution = from_json(
+                    t.SyncCommitteeContribution, res["data"]
+                )
+                cp = t.ContributionAndProof.default()
+                cp.aggregator_index = int(duty["validator_index"])
+                cp.contribution = contribution
+                cp.selection_proof = proof
+                signed = self.store.sign_contribution_and_proof(pk, cp)
+                try:
+                    self.client.publish_contribution_and_proofs(
+                        [to_json(t.SignedContributionAndProof, signed)]
+                    )
+                    contributions.append(signed)
+                except Exception as e:
+                    self.log.warning("contribution publish failed: %s", e)
+        return messages, contributions

@@ -69,3 +69,52 @@ def test_rest_validator_full_duty_loop(minimal_preset):
         assert chain.get_head_state().slot == 2
     finally:
         server.stop()
+
+
+def test_rest_validator_sync_committee_duties(minimal_preset):
+    """Sync-committee duties entirely over the Beacon API (r3 verdict #7
+    Done criterion): duties/sync -> pool/sync_committees ->
+    sync_committee_contribution -> contribution_and_proofs, against an
+    altair chain, with REAL signature verification server-side."""
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    far = 2**64 - 1
+    chain_cfg = minimal_chain_config().replace(
+        ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=far,
+        CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far,
+    )
+    genesis = create_interop_genesis_state(
+        N, p=p, genesis_fork_version=chain_cfg.GENESIS_FORK_VERSION
+    )
+    # altair from genesis: upgrade the anchor state
+    from lodestar_tpu.state_transition.altair import upgrade_to_altair
+
+    genesis = upgrade_to_altair(genesis, chain_cfg, p)
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsSingleThreadVerifier(),
+        db=MemoryDbController(),
+        cfg=chain_cfg,
+        current_slot=1,
+    )
+    server = BeaconRestApiServer(BeaconApiImpl(chain), port=0)
+    server.start()
+    try:
+        cfg = create_beacon_config(chain_cfg, bytes(genesis.genesis_validators_root))
+        store = ValidatorStore(cfg, SlashingProtection(MemoryDbController()), sks, p)
+        rv = RestValidator(
+            client=BeaconApiClient(f"http://127.0.0.1:{server.port}"), store=store, p=p
+        )
+        out = rv.run_slot_duties(1)
+        assert out["proposed"] is not None
+        assert out["sync_messages"], "no sync messages submitted over REST"
+        # messages landed in the node's pool, signature-verified: a
+        # contribution for subnet 0 must now be available
+        contribution = chain.sync_committee_message_pool.get_contribution(
+            0, 1, chain.head_root
+        )
+        assert contribution is not None
+        assert sum(1 for b in contribution.aggregation_bits if b) >= 1
+        assert out["sync_contributions"], "no contributions published over REST"
+    finally:
+        server.stop()
