@@ -235,6 +235,7 @@ class BeaconChain:
     def on_slot(self, slot: int) -> None:
         if slot <= self.fork_choice.current_slot:
             return  # a stale timer tick must never rewind the store clock
+        prev_epoch = self.fork_choice.current_slot // self.p.SLOTS_PER_EPOCH
         self.fork_choice.on_tick(slot)
         self.attestation_pool.prune(slot)
         self.aggregated_attestation_pool.prune(slot)
@@ -242,6 +243,16 @@ class BeaconChain:
         self.sync_contribution_pool.prune(slot)
         self.seen_sync_messages.prune(slot - 3)
         self.seen_sync_aggregators.prune(slot - 3)
+        if self.metrics is not None:
+            self.metrics.clock_slot.set(slot)
+            epoch = slot // self.p.SLOTS_PER_EPOCH
+            if epoch > prev_epoch:
+                summary = self.metrics.validator_monitor.on_epoch(epoch)
+                if summary and summary.get("missed"):
+                    self.log.info(
+                        f"validator monitor epoch {summary['epoch']}: "
+                        f"{summary['attested']} attested, {summary['missed']} missed"
+                    )
 
     # -- block store -----------------------------------------------------------
 
@@ -419,6 +430,9 @@ class BeaconChain:
         # counts, not just gossip — reference validatorMonitor)
         blk_proposer_epoch = compute_epoch_at_slot(block.slot, self.p)
         self.seen_block_proposers.add(blk_proposer_epoch, int(block.proposer_index))
+        monitor = self.metrics.validator_monitor if self.metrics is not None else None
+        if monitor is not None:
+            monitor.on_block_imported(int(block.slot), int(block.proposer_index))
         for att in block.body.attestations:
             try:
                 attesting = ctx.get_attesting_indices(att.data, att.aggregation_bits)
@@ -426,6 +440,12 @@ class BeaconChain:
                 continue
             for i in attesting:
                 self.seen_block_attesters.add(int(att.data.target.epoch), int(i))
+            if monitor is not None:
+                monitor.on_attestation_in_block(
+                    int(att.data.target.epoch),
+                    [int(i) for i in attesting],
+                    int(block.slot) - int(att.data.slot),
+                )
             self.fork_choice.on_attestation(
                 [int(i) for i in attesting],
                 _hex(bytes(att.data.beacon_block_root)),

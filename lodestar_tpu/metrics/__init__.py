@@ -23,7 +23,15 @@ from prometheus_client import (
     generate_latest,
 )
 
-__all__ = ["RegistryMetricCreator", "BeaconMetrics", "create_metrics", "MetricsServer"]
+from .validator_monitor import ValidatorMonitor
+
+__all__ = [
+    "RegistryMetricCreator",
+    "BeaconMetrics",
+    "create_metrics",
+    "MetricsServer",
+    "ValidatorMonitor",
+]
 
 
 class RegistryMetricCreator:
@@ -91,16 +99,78 @@ class ForkChoiceMetrics:
 
 
 @dataclass
+class NetworkMetrics:
+    peers_by_direction: Gauge
+    peer_disconnects: Counter
+    gossip_mesh_peers: Gauge
+    gossip_received: Counter
+    gossip_duplicates: Counter
+    reqresp_requests_sent: Counter
+    reqresp_requests_received: Counter
+    reqresp_errors: Counter
+
+
+@dataclass
+class SyncMetrics:
+    range_sync_batches: Counter
+    range_sync_blocks: Counter
+    range_sync_errors: Counter
+    backfill_blocks: Counter
+    unknown_block_requests: Counter
+
+
+@dataclass
+class DbMetrics:
+    reads: Counter
+    writes: Counter
+    size_bytes: Gauge
+
+
+@dataclass
+class RegenMetrics:
+    state_cache_hits: Counter
+    state_cache_misses: Counter
+    checkpoint_cache_hits: Counter
+    regen_queue_length: Gauge
+    regen_time: Histogram
+
+
+@dataclass
+class OpPoolMetrics:
+    attestation_pool_size: Gauge
+    aggregated_pool_size: Gauge
+    exits: Gauge
+    proposer_slashings: Gauge
+    attester_slashings: Gauge
+    sync_messages: Gauge
+
+
+@dataclass
+class ApiMetrics:
+    rest_requests: Counter
+    rest_errors: Counter
+    rest_response_time: Histogram
+
+
+@dataclass
 class BeaconMetrics:
     creator: RegistryMetricCreator
     bls_pool: BlsPoolMetrics
     state_transition: StateTransitionMetrics
     gossip: GossipMetrics
     fork_choice: ForkChoiceMetrics
+    network: "NetworkMetrics"
+    sync: "SyncMetrics"
+    db: "DbMetrics"
+    regen: "RegenMetrics"
+    op_pool: "OpPoolMetrics"
+    api: "ApiMetrics"
     head_slot: Gauge
     finalized_epoch: Gauge
     justified_epoch: Gauge
+    clock_slot: Gauge
     peers: Gauge
+    validator_monitor: "ValidatorMonitor"
 
     def scrape(self) -> bytes:
         return self.creator.scrape()
@@ -179,16 +249,114 @@ def create_metrics() -> BeaconMetrics:
         errors=c.counter("lodestar_fork_choice_errors_total", "fork choice errors"),
         reorgs=c.counter("lodestar_fork_choice_reorg_events_total", "reorg events"),
     )
+    network = NetworkMetrics(
+        peers_by_direction=c.gauge(
+            "lodestar_peers_by_direction_count", "Connected peers by direction", ["direction"]
+        ),
+        peer_disconnects=c.counter(
+            "lodestar_peer_disconnects_total", "Peer disconnects", ["reason"]
+        ),
+        gossip_mesh_peers=c.gauge(
+            "lodestar_gossip_mesh_peers_by_type_count", "Gossip mesh peers", ["type"]
+        ),
+        gossip_received=c.counter(
+            "lodestar_gossip_peer_received_messages_total", "Gossip messages received"
+        ),
+        gossip_duplicates=c.counter(
+            "lodestar_gossipsub_seen_cache_duplicates_total", "Duplicate gossip messages"
+        ),
+        reqresp_requests_sent=c.counter(
+            "beacon_reqresp_outgoing_requests_total", "Outgoing reqresp requests", ["method"]
+        ),
+        reqresp_requests_received=c.counter(
+            "beacon_reqresp_incoming_requests_total", "Incoming reqresp requests", ["method"]
+        ),
+        reqresp_errors=c.counter(
+            "beacon_reqresp_outgoing_errors_total", "Reqresp errors", ["method"]
+        ),
+    )
+    sync = SyncMetrics(
+        range_sync_batches=c.counter(
+            "lodestar_sync_range_batches_total", "Range-sync batches processed", ["status"]
+        ),
+        range_sync_blocks=c.counter(
+            "lodestar_sync_range_blocks_total", "Blocks imported by range sync"
+        ),
+        range_sync_errors=c.counter(
+            "lodestar_sync_range_errors_total", "Range sync batch failures"
+        ),
+        backfill_blocks=c.counter(
+            "lodestar_backfill_sync_blocks_total", "Blocks verified by backfill"
+        ),
+        unknown_block_requests=c.counter(
+            "lodestar_sync_unknown_block_requests_total", "Unknown-block sync triggers"
+        ),
+    )
+    db = DbMetrics(
+        reads=c.counter("lodestar_db_read_req_total", "DB read requests", ["bucket"]),
+        writes=c.counter("lodestar_db_write_req_total", "DB write requests", ["bucket"]),
+        size_bytes=c.gauge("lodestar_db_size_bytes", "Approximate DB size"),
+    )
+    regen = RegenMetrics(
+        state_cache_hits=c.counter("lodestar_state_cache_hits_total", "State cache hits"),
+        state_cache_misses=c.counter(
+            "lodestar_state_cache_misses_total", "State cache misses"
+        ),
+        checkpoint_cache_hits=c.counter(
+            "lodestar_cp_state_cache_hits_total", "Checkpoint state cache hits"
+        ),
+        regen_queue_length=c.gauge(
+            "lodestar_regen_queue_length", "Queued regen requests"
+        ),
+        regen_time=c.histogram(
+            "lodestar_regen_fn_call_duration_seconds", "State regen time", _SEC_SMALL
+        ),
+    )
+    op_pool = OpPoolMetrics(
+        attestation_pool_size=c.gauge(
+            "lodestar_op_pool_attestation_pool_size", "Unaggregated attestation pool size"
+        ),
+        aggregated_pool_size=c.gauge(
+            "lodestar_op_pool_aggregated_attestation_pool_size", "Aggregated pool size"
+        ),
+        exits=c.gauge("lodestar_op_pool_voluntary_exit_pool_size", "Voluntary exits pooled"),
+        proposer_slashings=c.gauge(
+            "lodestar_op_pool_proposer_slashing_pool_size", "Proposer slashings pooled"
+        ),
+        attester_slashings=c.gauge(
+            "lodestar_op_pool_attester_slashing_pool_size", "Attester slashings pooled"
+        ),
+        sync_messages=c.gauge(
+            "lodestar_op_pool_sync_committee_message_pool_size", "Sync messages pooled"
+        ),
+    )
+    api = ApiMetrics(
+        rest_requests=c.counter(
+            "lodestar_api_rest_requests_total", "REST API requests", ["method", "status"]
+        ),
+        rest_errors=c.counter("lodestar_api_rest_errors_total", "REST API 5xx errors"),
+        rest_response_time=c.histogram(
+            "lodestar_api_rest_response_time_seconds", "REST response time", _SEC_SMALL
+        ),
+    )
     return BeaconMetrics(
         creator=c,
         bls_pool=bls,
         state_transition=st,
         gossip=gossip,
         fork_choice=fc,
+        network=network,
+        sync=sync,
+        db=db,
+        regen=regen,
+        op_pool=op_pool,
+        api=api,
         head_slot=c.gauge("beacon_head_slot", "Current head slot"),
         finalized_epoch=c.gauge("beacon_finalized_epoch", "Finalized epoch"),
         justified_epoch=c.gauge("beacon_current_justified_epoch", "Justified epoch"),
+        clock_slot=c.gauge("beacon_clock_slot", "Current wall-clock slot"),
         peers=c.gauge("libp2p_peers", "Connected peers"),
+        validator_monitor=ValidatorMonitor(c),
     )
 
 

@@ -195,6 +195,10 @@ def _import_verified_attestation_locked(chain, res, attestation, aggregated: boo
         data.target.epoch,
         data.slot,
     )
+    if chain.metrics is not None:
+        chain.metrics.validator_monitor.on_gossip_attestation(
+            int(data.target.epoch), res.attesting_indices
+        )
 
 
 def default_gossip_handlers(chain) -> dict:

@@ -53,6 +53,15 @@ class Validator:
         head_state = self.chain.get_head_state()
         work, ctx = dial_to_slot(head_state, slot, self.p, self.chain.cfg)
 
+        # register managed keys with the validator monitor (reference
+        # validatorMonitor.registerLocalValidator on every duty poll)
+        if self.chain.metrics is not None:
+            monitor = self.chain.metrics.validator_monitor
+            idx_map = ctx.pubkey_to_index(work)
+            for pk, vi in idx_map.items():
+                if self.store.has_pubkey(pk):
+                    monitor.register_local_validator(vi)
+
         # -- proposal (services/block.ts) --
         proposer_index = ctx.get_beacon_proposer(slot)
         proposer_pk = bytes(work.validators[proposer_index].pubkey)

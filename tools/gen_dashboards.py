@@ -1,0 +1,285 @@
+"""Generate the Grafana dashboards under dashboards/ (reference ships 16
+under /dashboards; these cover the subsystems this framework actually
+exports, wired to the repo's metric names so a Grafana + Prometheus pair
+scraping the node renders them unmodified).
+
+Run from the repo root: python tools/gen_dashboards.py
+"""
+
+import json
+import os
+
+OUT = "dashboards"
+
+
+def panel(title, exprs, *, unit="short", x=0, y=0, w=12, h=8, pid=1, kind="timeseries"):
+    targets = [
+        {"expr": e, "legendFormat": leg, "refId": chr(ord("A") + i)}
+        for i, (e, leg) in enumerate(exprs)
+    ]
+    return {
+        "id": pid,
+        "title": title,
+        "type": kind,
+        "datasource": {"type": "prometheus", "uid": "${DS_PROMETHEUS}"},
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": targets,
+    }
+
+
+def dashboard(uid, title, panels, tags):
+    return {
+        "uid": uid,
+        "title": title,
+        "tags": tags,
+        "timezone": "utc",
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "DS_PROMETHEUS",
+                    "type": "datasource",
+                    "query": "prometheus",
+                    "current": {},
+                }
+            ]
+        },
+        "panels": panels,
+    }
+
+
+def bls_pool():
+    ps = [
+        panel(
+            "Signature throughput (sets/s)",
+            [
+                ("rate(lodestar_bls_thread_pool_sig_sets_started_total[1m])", "started"),
+                ("rate(lodestar_bls_thread_pool_batch_sigs_success_total[1m])", "batch success"),
+                ("rate(lodestar_bls_thread_pool_success_jobs_signature_sets_count[1m])", "success"),
+            ],
+            unit="ops", x=0, y=0, pid=1,
+        ),
+        panel(
+            "Jobs started / errors",
+            [
+                ("rate(lodestar_bls_thread_pool_jobs_started_total[1m])", "jobs"),
+                ("rate(lodestar_bls_thread_pool_error_jobs_signature_sets_count[1m])", "error sets"),
+                ("rate(lodestar_bls_thread_pool_batch_retries_total[1m])", "batch retries"),
+            ],
+            unit="ops", x=12, y=0, pid=2,
+        ),
+        panel(
+            "Queue wait time",
+            [
+                (
+                    "histogram_quantile(0.5, rate(lodestar_bls_thread_pool_queue_job_wait_time_seconds_bucket[5m]))",
+                    "p50",
+                ),
+                (
+                    "histogram_quantile(0.95, rate(lodestar_bls_thread_pool_queue_job_wait_time_seconds_bucket[5m]))",
+                    "p95",
+                ),
+            ],
+            unit="s", x=0, y=8, pid=3,
+        ),
+        panel(
+            "Device time per signature set",
+            [
+                (
+                    "histogram_quantile(0.5, rate(lodestar_bls_thread_pool_time_per_sig_set_seconds_bucket[5m]))",
+                    "p50",
+                ),
+                (
+                    "histogram_quantile(0.95, rate(lodestar_bls_thread_pool_time_per_sig_set_seconds_bucket[5m]))",
+                    "p95",
+                ),
+            ],
+            unit="s", x=12, y=8, pid=4,
+        ),
+    ]
+    return dashboard("lodestar-bls-pool", "Lodestar TPU - BLS verifier pool", ps, ["lodestar", "bls"])
+
+
+def block_processor():
+    ps = [
+        panel(
+            "Head / finalized",
+            [
+                ("beacon_head_slot", "head slot"),
+                ("beacon_clock_slot", "clock slot"),
+                ("beacon_finalized_epoch * 8", "finalized (slots)"),
+            ],
+            x=0, y=0, pid=1,
+        ),
+        panel(
+            "Block processing time",
+            [
+                (
+                    "histogram_quantile(0.5, rate(lodestar_stfn_process_block_seconds_bucket[5m]))",
+                    "p50",
+                ),
+                (
+                    "histogram_quantile(0.95, rate(lodestar_stfn_process_block_seconds_bucket[5m]))",
+                    "p95",
+                ),
+            ],
+            unit="s", x=12, y=0, pid=2,
+        ),
+        panel(
+            "Epoch transition / hashTreeRoot",
+            [
+                (
+                    "histogram_quantile(0.95, rate(lodestar_stfn_epoch_transition_seconds_bucket[5m]))",
+                    "epoch p95",
+                ),
+                (
+                    "histogram_quantile(0.95, rate(lodestar_stfn_hash_tree_root_seconds_bucket[5m]))",
+                    "htr p95",
+                ),
+            ],
+            unit="s", x=0, y=8, pid=3,
+        ),
+        panel(
+            "Gossip queues",
+            [
+                ("lodestar_gossip_validation_queue_length", "{{topic}}"),
+                ("rate(lodestar_gossip_validation_queue_dropped_jobs_total[1m])", "dropped {{topic}}"),
+            ],
+            x=12, y=8, pid=4,
+        ),
+        panel(
+            "State caches",
+            [
+                ("rate(lodestar_state_cache_hits_total[1m])", "state hits"),
+                ("rate(lodestar_state_cache_misses_total[1m])", "state misses"),
+                ("rate(lodestar_cp_state_cache_hits_total[1m])", "checkpoint hits"),
+            ],
+            unit="ops", x=0, y=16, pid=5,
+        ),
+        panel(
+            "Fork choice",
+            [
+                ("rate(lodestar_fork_choice_requests_total[1m])", "findHead"),
+                ("rate(lodestar_fork_choice_reorg_events_total[1m])", "reorgs"),
+                ("rate(lodestar_fork_choice_errors_total[1m])", "errors"),
+            ],
+            unit="ops", x=12, y=16, pid=6,
+        ),
+    ]
+    return dashboard(
+        "lodestar-block-processor", "Lodestar TPU - Block processor", ps, ["lodestar", "chain"]
+    )
+
+
+def networking():
+    ps = [
+        panel(
+            "Peers",
+            [
+                ("libp2p_peers", "total"),
+                ("lodestar_peers_by_direction_count", "{{direction}}"),
+            ],
+            x=0, y=0, pid=1,
+        ),
+        panel(
+            "Gossip traffic",
+            [
+                ("rate(lodestar_gossip_peer_received_messages_total[1m])", "received"),
+                ("rate(lodestar_gossipsub_seen_cache_duplicates_total[1m])", "duplicates"),
+            ],
+            unit="ops", x=12, y=0, pid=2,
+        ),
+        panel(
+            "ReqResp",
+            [
+                ("rate(beacon_reqresp_outgoing_requests_total[1m])", "out {{method}}"),
+                ("rate(beacon_reqresp_incoming_requests_total[1m])", "in {{method}}"),
+                ("rate(beacon_reqresp_outgoing_errors_total[1m])", "errors {{method}}"),
+            ],
+            unit="ops", x=0, y=8, pid=3,
+        ),
+        panel(
+            "Sync",
+            [
+                ("rate(lodestar_sync_range_blocks_total[1m])", "range blocks"),
+                ("rate(lodestar_sync_range_errors_total[1m])", "range errors"),
+                ("rate(lodestar_backfill_sync_blocks_total[1m])", "backfill blocks"),
+            ],
+            unit="ops", x=12, y=8, pid=4,
+        ),
+    ]
+    return dashboard(
+        "lodestar-networking", "Lodestar TPU - Networking & sync", ps, ["lodestar", "network"]
+    )
+
+
+def validator_monitor():
+    ps = [
+        panel(
+            "Local validators",
+            [("validator_monitor_validators_total", "registered")],
+            x=0, y=0, w=6, pid=1, kind="stat",
+        ),
+        panel(
+            "Proposals",
+            [("rate(validator_monitor_beacon_block_total[10m])", "blocks")],
+            unit="ops", x=6, y=0, w=6, pid=2,
+        ),
+        panel(
+            "Attestation hits / misses per epoch",
+            [
+                ("increase(validator_monitor_prev_epoch_attestations_total[10m])", "attested"),
+                (
+                    "increase(validator_monitor_prev_epoch_attestations_missed_total[10m])",
+                    "missed",
+                ),
+            ],
+            x=12, y=0, pid=3,
+        ),
+        panel(
+            "Inclusion distance",
+            [
+                (
+                    "histogram_quantile(0.5, rate(validator_monitor_prev_epoch_attestation_inclusion_distance_bucket[10m]))",
+                    "p50",
+                ),
+                (
+                    "histogram_quantile(0.95, rate(validator_monitor_prev_epoch_attestation_inclusion_distance_bucket[10m]))",
+                    "p95",
+                ),
+            ],
+            x=0, y=8, pid=4,
+        ),
+        panel(
+            "Gossip-seen local attestations",
+            [("rate(validator_monitor_unaggregated_attestation_total[1m])", "seen")],
+            unit="ops", x=12, y=8, pid=5,
+        ),
+    ]
+    return dashboard(
+        "lodestar-validator-monitor", "Lodestar TPU - Validator monitor", ps,
+        ["lodestar", "validator"],
+    )
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for name, dash in (
+        ("lodestar_bls_verifier_pool.json", bls_pool()),
+        ("lodestar_block_processor.json", block_processor()),
+        ("lodestar_networking.json", networking()),
+        ("lodestar_validator_monitor.json", validator_monitor()),
+    ):
+        path = os.path.join(OUT, name)
+        with open(path, "w") as f:
+            json.dump(dash, f, indent=2)
+            f.write("\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
