@@ -120,6 +120,25 @@ class BeaconApiClient:
     def get_block_root(self, block_id: str):
         return self._req("GET", f"/eth/v1/beacon/blocks/{block_id}/root")
 
+    # light-client namespace
+    def get_lc_bootstrap(self, block_root_hex: str):
+        return self._req(
+            "GET", f"/eth/v1/beacon/light_client/bootstrap/{block_root_hex}"
+        )
+
+    def get_lc_updates(self, start_period: int, count: int):
+        return self._req(
+            "GET",
+            "/eth/v1/beacon/light_client/updates",
+            {"start_period": str(start_period), "count": str(count)},
+        )
+
+    def get_lc_finality_update(self):
+        return self._req("GET", "/eth/v1/beacon/light_client/finality_update")
+
+    def get_lc_optimistic_update(self):
+        return self._req("GET", "/eth/v1/beacon/light_client/optimistic_update")
+
     def get_health(self) -> int:
         try:
             self._req("GET", "/eth/v1/node/health")

@@ -43,19 +43,23 @@ class LightClientServer:
         if parent_node is None:
             return
 
+        # the attested header must reconstruct EXACTLY (clients verify the
+        # sync aggregate against hash_tree_root(attested_header)); without
+        # the stored parent block (e.g. the anchor) no valid update exists
+        parent_block = self.chain.get_block_by_root(parent_root)
+        if parent_block is None:
+            return
+
         update = t.LightClientUpdate.default()
         att = t.LightClientHeader.default()
         att.beacon.slot = parent_node.slot
         att.beacon.parent_root = bytes.fromhex(parent_node.parent_root[2:])
         att.beacon.state_root = bytes.fromhex(parent_node.state_root[2:])
-        # body root from the stored parent block when available
-        parent_block = self.chain.get_block_by_root(parent_root)
-        if parent_block is not None:
-            from lodestar_tpu.state_transition.block import block_types_for
+        from lodestar_tpu.state_transition.block import block_types_for
 
-            _, body_t = block_types_for(attested_state, self.p)
-            att.beacon.body_root = body_t.hash_tree_root(parent_block.message.body)
-            att.beacon.proposer_index = parent_block.message.proposer_index
+        _, body_t = block_types_for(attested_state, self.p)
+        att.beacon.body_root = body_t.hash_tree_root(parent_block.message.body)
+        att.beacon.proposer_index = parent_block.message.proposer_index
         update.attested_header = att
 
         # next sync committee proof from the attested state
@@ -105,9 +109,23 @@ class LightClientServer:
         node = self.chain.fork_choice.proto_array.get_block("0x" + block_root.hex())
         if node is None:
             raise KeyError(f"unknown block 0x{block_root.hex()[:16]}")
+        # the FULL header: clients verify hash_tree_root(header) against
+        # their trusted block root (reference lightclient bootstrap); an
+        # unreconstructible header would fail client-side anyway, so a
+        # missing block is a clean not-found
+        signed = self.chain.get_block_by_root(block_root)
+        if signed is None:
+            return None
         boot = t.LightClientBootstrap.default()
         boot.header.beacon.slot = node.slot
         boot.header.beacon.state_root = bytes.fromhex(node.state_root[2:])
+        msg = signed.message
+        boot.header.beacon.proposer_index = int(msg.proposer_index)
+        boot.header.beacon.parent_root = bytes(msg.parent_root)
+        from lodestar_tpu.state_transition.block import fork_of
+
+        ns = getattr(t, fork_of(msg))
+        boot.header.beacon.body_root = ns.BeaconBlockBody.hash_tree_root(msg.body)
         boot.current_sync_committee = state.current_sync_committee
         boot.current_sync_committee_branch = produce_state_field_branch(
             state, "current_sync_committee"

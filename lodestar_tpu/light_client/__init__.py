@@ -205,3 +205,52 @@ class LightClientStore:
             if bytes(update.next_sync_committee.aggregate_pubkey) != b"\x00" * 48:
                 self.next_sync_committee = update.next_sync_committee
             self.best_valid_update = None
+
+    def force_update(self, current_slot: int) -> bool:
+        """Spec process_light_client_store_force_update: past
+        UPDATE_TIMEOUT (one sync-committee period) without finality
+        evidence, adopt the best valid update's attested header as
+        finalized so the store can keep moving."""
+        upd = self.best_valid_update
+        if upd is None:
+            return False
+        timeout = self.p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * self.p.SLOTS_PER_EPOCH
+        if int(current_slot) <= int(self.finalized_header.beacon.slot) + timeout:
+            return False
+        progressed = False
+        # adopting the update's next committee is progress on its own: it
+        # unlocks validating the NEXT period's updates even when the
+        # attested header itself is older than our finalized header
+        if (
+            self.next_sync_committee is None
+            and bytes(upd.next_sync_committee.aggregate_pubkey) != b"\x00" * 48
+        ):
+            self.next_sync_committee = upd.next_sync_committee
+            progressed = True
+        att = upd.attested_header
+        if int(att.beacon.slot) > int(self.finalized_header.beacon.slot):
+            prev_period = sync_committee_period(
+                int(self.finalized_header.beacon.slot) // self.p.SLOTS_PER_EPOCH, self.p
+            )
+            new_period = sync_committee_period(
+                int(att.beacon.slot) // self.p.SLOTS_PER_EPOCH, self.p
+            )
+            if new_period > prev_period:
+                if self.next_sync_committee is None:
+                    return progressed  # cannot cross a period blind
+                self.current_sync_committee = self.next_sync_committee
+                # adopt the update's own next committee across the
+                # rotation (spec apply_light_client_update) so the walk
+                # continues period by period without re-stalling
+                if bytes(upd.next_sync_committee.aggregate_pubkey) != b"\x00" * 48:
+                    self.next_sync_committee = upd.next_sync_committee
+                else:
+                    self.next_sync_committee = None
+            self.finalized_header = att
+            if self.optimistic_header is None or int(att.beacon.slot) > int(
+                self.optimistic_header.beacon.slot
+            ):
+                self.optimistic_header = att
+            progressed = True
+        self.best_valid_update = None
+        return progressed

@@ -23,6 +23,7 @@ __all__ = ["ValidatorMonitor"]
 class ValidatorMonitor:
     def __init__(self, creator):
         self._validators: set[int] = set()
+        self._first_observed_epoch: int | None = None
         # epoch -> index -> status
         self._gossip_seen: dict[int, set[int]] = defaultdict(set)
         self._included: dict[int, set[int]] = defaultdict(set)
@@ -71,12 +72,16 @@ class ValidatorMonitor:
             self.blocks_total.inc()
 
     def on_gossip_attestation(self, epoch: int, indices) -> None:
+        if self._first_observed_epoch is None:
+            self._first_observed_epoch = int(epoch)
         for i in indices:
             if int(i) in self._validators:
                 self._gossip_seen[int(epoch)].add(int(i))
                 self.gossip_attestations.inc()
 
     def on_attestation_in_block(self, epoch: int, indices, inclusion_distance: int) -> None:
+        if self._first_observed_epoch is None:
+            self._first_observed_epoch = int(epoch)
         dist = max(1, int(inclusion_distance))
         for i in indices:
             i = int(i)
@@ -97,6 +102,17 @@ class ValidatorMonitor:
         included = self._included.pop(target, set())
         self._gossip_seen.pop(target, None)
         distances = self._distances.pop(target, {})
+        # prune anything older than the flush target too (historical
+        # range-sync epochs and clock jumps would otherwise accumulate
+        # per-epoch sets for the process lifetime)
+        for store in (self._included, self._gossip_seen, self._distances):
+            for old in [e for e in store if e < target]:
+                del store[old]
+        # epochs before monitoring began have no observations by
+        # construction: judging them would report a spurious 100% miss on
+        # every restart
+        if self._first_observed_epoch is None or target < self._first_observed_epoch:
+            return {}
         hit = len(included & self._validators)
         miss = len(self._validators) - hit
         self.prev_epoch_attestations.inc(hit)

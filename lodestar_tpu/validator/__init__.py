@@ -53,13 +53,14 @@ class Validator:
         head_state = self.chain.get_head_state()
         work, ctx = dial_to_slot(head_state, slot, self.p, self.chain.cfg)
 
-        # register managed keys with the validator monitor (reference
-        # validatorMonitor.registerLocalValidator on every duty poll)
+        # register managed keys with the validator monitor — iterate the
+        # SMALL set (local keys), not the full validator registry
         if self.chain.metrics is not None:
             monitor = self.chain.metrics.validator_monitor
             idx_map = ctx.pubkey_to_index(work)
-            for pk, vi in idx_map.items():
-                if self.store.has_pubkey(pk):
+            for pk in self.store.pubkeys:
+                vi = idx_map.get(bytes(pk))
+                if vi is not None:
                     monitor.register_local_validator(vi)
 
         # -- proposal (services/block.ts) --
