@@ -1,0 +1,128 @@
+"""Pallas iteration-2 experiments on the real device.
+
+A: mont_mul kernel WITHOUT the exact-carry/borrow canonicalization tail
+   (loose <2p output) — isolates the unrolled-column tail cost.
+B: EIGHT chained lazy mont_muls inside ONE kernel (all intermediates in
+   VMEM) — measures the cross-op fusion payoff that would justify
+   building fused fp2/fp6/fp12 Pallas ops.
+Baselines: XLA mont_mul chain-8, pallas v1 single.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lodestar_tpu.ops import fp, fp_pallas
+from lodestar_tpu.utils import enable_compile_cache
+
+enable_compile_cache(".")
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096 * 54
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+BLOCK = fp_pallas.BLOCK
+
+_PP = [int(v) for v in fp.PPRIME_LIMBS]
+_PL = [int(v) for v in fp.P_LIMBS]
+
+
+def _lazy_mont_body(pad_ref, a, b):
+    """One lazy mont_mul on (BLOCK, 32) VMEM arrays -> loose (<2p)."""
+    zeros_pad = jnp.zeros((BLOCK, 128), jnp.int32)
+
+    def load(x32):
+        pad_ref[:] = zeros_pad
+        pad_ref[:, 64:96] = x32
+
+    def carry(x, width):
+        c = x >> 12
+        lo = x & 0xFFF
+        pad_ref[:] = zeros_pad
+        pad_ref[:, 64 : 64 + width] = c
+        return lo + pad_ref[:, 63 : 63 + width]
+
+    acc = jnp.zeros((BLOCK, 64), jnp.int32)
+    load(a)
+    for j in range(32):
+        acc = acc + pad_ref[:, 64 - j : 128 - j] * b[:, j : j + 1]
+    for _ in range(3):
+        acc = carry(acc, 64)
+    m = jnp.zeros((BLOCK, 32), jnp.int32)
+    load(acc[:, :32])
+    for j in range(32):
+        if _PP[j]:
+            m = m + pad_ref[:, 64 - j : 96 - j] * _PP[j]
+    for _ in range(3):
+        m = carry(m, 32)
+    s = acc
+    load(m)
+    for j in range(32):
+        if _PL[j]:
+            s = s + pad_ref[:, 64 - j : 128 - j] * _PL[j]
+    for _ in range(3):
+        s = carry(s, 64)
+    cbit = jnp.any(s[:, :32] != 0, axis=-1, keepdims=True).astype(jnp.int32)
+    hi = s[:, 32:]
+    return jnp.concatenate([hi[:, :1] + cbit, hi[:, 1:]], axis=-1)
+
+
+def _kernel_lazy1(a_ref, b_ref, o_ref, pad_ref):
+    o_ref[:] = _lazy_mont_body(pad_ref, a_ref[:], b_ref[:])
+
+
+def _kernel_lazy8(a_ref, b_ref, o_ref, pad_ref):
+    x = a_ref[:]
+    b = b_ref[:]
+    for _ in range(8):
+        x = _lazy_mont_body(pad_ref, x, b)
+    o_ref[:] = x
+
+
+def _call(kernel, a, b):
+    n = a.shape[0]
+    spec = pl.BlockSpec((BLOCK, 32), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 32), jnp.int32),
+        grid=(n // BLOCK,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        scratch_shapes=[pltpu.VMEM((BLOCK, 128), jnp.int32)],
+    )(a, b)
+
+
+rng = np.random.default_rng(0)
+vals = lambda n: [int.from_bytes(rng.bytes(47), "big") % fp.P for _ in range(n)]
+n_pad = (B + BLOCK - 1) // BLOCK * BLOCK
+a = jnp.asarray(np.vstack([fp.limbs_from_ints(vals(B)), np.zeros((n_pad - B, 32), np.int32)]))
+b = jnp.asarray(np.vstack([fp.limbs_from_ints(vals(B)), np.zeros((n_pad - B, 32), np.int32)]))
+
+
+def bench(name, fn, iters=3, per_call_ops=1):
+    @jax.jit
+    def f(x, y):
+        out = x
+        for _ in range(K // per_call_ops):
+            out = fn(out, y)
+        return out[0, :1]
+
+    np.asarray(f(a, b))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.asarray(f(a, b))
+    dt = (time.perf_counter() - t0) / iters / K
+    print(f"{name:36s} {dt*1e3:8.3f} ms/mont_mul", flush=True)
+
+
+bench("XLA mont_mul (canonical)", fp.mont_mul)
+bench("pallas v1 (canonical)", lambda x, y: fp_pallas._mont_mul_flat(x, y))
+bench("pallas v2A lazy single", lambda x, y: _call(_kernel_lazy1, x, y))
+bench("pallas v2B lazy chain-8 in-kernel", lambda x, y: _call(_kernel_lazy8, x, y), per_call_ops=8)
+print("done", flush=True)
