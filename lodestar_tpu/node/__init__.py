@@ -36,6 +36,7 @@ class BeaconNodeOptions:
         p2p_enabled: bool = False,
         p2p_port: int = 0,
         bootnodes: list[tuple[str, int]] | None = None,
+        on_shutdown_request=None,
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -47,6 +48,9 @@ class BeaconNodeOptions:
         self.p2p_enabled = p2p_enabled
         self.p2p_port = p2p_port
         self.bootnodes = list(bootnodes or [])
+        # fatal-error callback (reference ProcessShutdownCallback): the
+        # embedding process decides how to die; None = log only
+        self.on_shutdown_request = on_shutdown_request
 
 
 class BeaconNode:
@@ -170,7 +174,16 @@ class BeaconNode:
             rest_server=rest_server, metrics_server=metrics_server, bls=bls,
             processor=processor,
         )
+
+        # status notifier + fatal-error policy (reference node/notifier.ts
+        # + chain/chain.ts processShutdownCallback)
+        from lodestar_tpu.node.notifier import ProcessFaultPolicy, StatusNotifier
+
+        node.fault = ProcessFaultPolicy(opts.on_shutdown_request)
+        chain.fault = node.fault
+        node.notifier = StatusNotifier(chain)
         if not opts.manual_clock:
+            clock.on_slot(node.notifier.on_slot)
             node.start_gossip_drain()
 
         # 8. P2P network (TCP + noise + mplex + gossipsub + reqresp)
@@ -184,6 +197,7 @@ class BeaconNode:
                 bootnodes=opts.bootnodes,
             )
             await node.network.start()
+            node.notifier.network = node.network
         node.log.info(
             f"beacon node up: slot {clock.current_slot}, "
             f"rest {'on :' + str(rest_server.port) if rest_server else 'off'}"

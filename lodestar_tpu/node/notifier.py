@@ -1,0 +1,86 @@
+"""Node status notifier + process-fault policy (reference
+`node/notifier.ts:29` runNodeNotifier and `chain/chain.ts:151`
+processShutdownCallback).
+
+`StatusNotifier` logs one human status line per slot (head vs clock,
+sync distance, peers, finalized epoch) and warns on low peer count.
+`ProcessFaultPolicy` is the abort seam: subsystems report fatal errors
+(`on_fatal`), which invoke the node's shutdown callback exactly once —
+the reference wires the same callback into the chain so corrupted state
+triggers a clean process exit instead of limping on.
+"""
+
+from __future__ import annotations
+
+import time
+
+from lodestar_tpu.logger import get_logger
+
+__all__ = ["StatusNotifier", "ProcessFaultPolicy"]
+
+LOW_PEER_COUNT = 3
+
+
+class ProcessFaultPolicy:
+    """Fatal-error funnel: first `on_fatal` fires the shutdown callback
+    (reference ProcessShutdownCallback), later ones only log."""
+
+    def __init__(self, shutdown_callback=None):
+        self._shutdown = shutdown_callback
+        self.fired = False
+        self.reason: str | None = None
+        self.log = get_logger(name="lodestar.fault")
+
+    def on_fatal(self, subsystem: str, err: BaseException | str) -> None:
+        msg = f"fatal error in {subsystem}: {err}"
+        if self.fired:
+            self.log.error(f"{msg} (shutdown already requested: {self.reason})")
+            return
+        self.fired = True
+        self.reason = msg
+        self.log.error(f"{msg} — requesting process shutdown")
+        if self._shutdown is not None:
+            try:
+                self._shutdown(msg)
+            except Exception as e:  # the callback must never mask the fault
+                self.log.error(f"shutdown callback failed: {e!r}")
+
+
+class StatusNotifier:
+    """Per-slot status line, driven by the node clock."""
+
+    def __init__(self, chain, *, network=None, time_fn=time.monotonic):
+        self.chain = chain
+        self.network = network
+        self._time = time_fn
+        self._last_head_slot = 0
+        self._last_t = time_fn()
+        self.log = get_logger(name="lodestar.notifier")
+
+    def on_slot(self, clock_slot: int) -> str:
+        fc = self.chain.fork_choice
+        head = fc.proto_array.get_block(fc.head)
+        head_slot = head.slot if head else 0
+        skipped = max(0, clock_slot - head_slot)
+        peers = len(self.network.host.peers()) if self.network is not None else 0
+
+        now = self._time()
+        dt = max(now - self._last_t, 1e-9)
+        speed = (head_slot - self._last_head_slot) / dt
+        self._last_head_slot, self._last_t = head_slot, now
+
+        if skipped <= 3:
+            state = "synced"
+        else:
+            state = f"syncing ({speed:.2f} slots/s, -{skipped} behind)"
+        line = (
+            f"{state} - slot: {clock_slot}"
+            + (f" (head -{skipped})" if skipped else "")
+            + f" - head: {head_slot} {head.block_root[:12] if head else '-'}"
+            + f" - finalized: {fc.finalized.epoch}"
+            + f" - peers: {peers}"
+        )
+        self.log.info(line)
+        if self.network is not None and peers < LOW_PEER_COUNT:
+            self.log.warn(f"low peer count: {peers}")
+        return line

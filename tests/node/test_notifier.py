@@ -1,0 +1,62 @@
+"""Status notifier + process-fault policy (r3 verdict Missing #7)."""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.node import BeaconNode, BeaconNodeOptions
+from lodestar_tpu.node.notifier import ProcessFaultPolicy, StatusNotifier
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def test_fault_policy_fires_shutdown_once():
+    calls = []
+    policy = ProcessFaultPolicy(lambda reason: calls.append(reason))
+    policy.on_fatal("chain", RuntimeError("state corrupt"))
+    policy.on_fatal("db", RuntimeError("disk gone"))  # second: log only
+    assert len(calls) == 1
+    assert "chain" in calls[0] and "state corrupt" in calls[0]
+    assert policy.fired and "chain" in policy.reason
+
+
+def test_fault_policy_without_callback_only_logs():
+    policy = ProcessFaultPolicy(None)
+    policy.on_fatal("sync", "batch import wedged")
+    assert policy.fired
+
+
+def test_notifier_status_line_and_node_wiring(minimal_preset):
+    async def run():
+        genesis = create_interop_genesis_state(8, p=minimal_preset)
+        seen = []
+        node = await BeaconNode.init(
+            anchor_state=genesis,
+            opts=BeaconNodeOptions(
+                rest_enabled=False,
+                manual_clock=True,
+                on_shutdown_request=lambda reason: seen.append(reason),
+            ),
+            p=minimal_preset,
+            time_fn=lambda: 0.0,
+        )
+        # the notifier + fault policy are wired onto the node and chain
+        assert isinstance(node.notifier, StatusNotifier)
+        assert node.chain.fault is node.fault
+        line = node.notifier.on_slot(5)
+        assert "slot: 5" in line and "finalized:" in line and "peers:" in line
+        assert "syncing" in line  # head 0 vs clock 5
+
+        node.fault.on_fatal("chain", "unrecoverable import error")
+        assert seen and "unrecoverable" in seen[0]
+        await node.close()
+
+    asyncio.run(run())
