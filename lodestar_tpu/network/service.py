@@ -86,6 +86,9 @@ class Libp2pBeaconNetwork:
         bootnodes: list[tuple[str, int]] | None = None,
         identity: Identity | None = None,
         subscribe_subnets: int = 2,
+        discv5_port: int | None = None,
+        discv5_bootnodes: list | None = None,
+        target_peers: int = 55,
     ):
         self.node = node
         self.chain = chain
@@ -98,6 +101,12 @@ class Libp2pBeaconNetwork:
         self.log = get_logger(name="lodestar.network")
         chain.network = self  # node/api surfaces (node identity, peers) read this
         self._digest_to_fork: dict[bytes, str] = {}
+        # optional discv5 DHT (None = static bootnodes only)
+        self.discv5 = None
+        self._discv5_port = discv5_port
+        self._discv5_bootnodes = list(discv5_bootnodes or [])
+        self.target_peers = target_peers
+        self._discovery_task = None
         self.gossip.set_validator(self._validate_gossip)
         self.host.on_peer_connect = self._on_peer_connect
         self.host.on_peer_disconnect = self._on_peer_disconnect
@@ -123,10 +132,83 @@ class Libp2pBeaconNetwork:
                 await self.host.connect(bhost, bport)
             except Exception as e:
                 self.log.warn(f"bootnode {bhost}:{bport} dial failed: {e}")
+
+        # discv5 DHT: advertise our tcp endpoint + fork digest, discover
+        # peers' tcp endpoints and keep dialing toward the target
+        if self._discv5_port is not None:
+            from lodestar_tpu.network.discv5 import Discv5Node
+
+            self.discv5 = Discv5Node(
+                ip=host_addr,
+                port=self._discv5_port,
+                tcp_port=port,
+                enr_extra={b"eth2": self.current_fork_digest()},
+                bootnodes=self._discv5_bootnodes,
+            )
+            await self.discv5.start()
+            self._discovery_task = asyncio.ensure_future(self._discovery_loop())
+
         self.log.info(f"p2p listening on {host_addr}:{port} as {self.host.peer_id}")
         return port
 
+    async def _discovery_loop(self, interval: float = 5.0) -> None:
+        """Bootstrap the DHT while under-peered, then dial discovered
+        TCP endpoints (reference peers/discover.ts driving dials from
+        discv5 ENRs). Per-node dial backoff prevents both re-dial churn
+        of live inbound peers and hammering refusing endpoints."""
+        import time as _time
+
+        dialed: dict[bytes, tuple[float, str | None]] = {}
+        #   discv5 node id -> (last dial time, connected libp2p peer id)
+        DIAL_BACKOFF = 60.0
+        while True:
+            try:
+                if len(self.host.peers()) >= self.target_peers:
+                    await asyncio.sleep(interval)
+                    continue
+                # keep the ENR's fork digest current across transitions
+                digest = self.current_fork_digest()
+                if self.discv5.enr.pairs.get(b"eth2") != digest:
+                    self.discv5.enr.pairs[b"eth2"] = digest
+                    self.discv5.enr.seq += 1
+                    self.discv5.enr.sign(self.discv5.key)
+                await self.discv5.bootstrap(rounds=1)
+                now = _time.monotonic()
+                for enr in self.discv5.enr_source():
+                    if enr.node_id == self.discv5.node_id:
+                        continue
+                    if enr.pairs.get(b"eth2", digest) != digest:
+                        continue  # wrong fork
+                    tcp = enr.pairs.get(b"tcp")
+                    ep = enr.udp_endpoint
+                    if not tcp or ep is None:
+                        continue
+                    last, peer_id = dialed.get(enr.node_id, (0.0, None))
+                    if peer_id is not None and peer_id in self.host.connections:
+                        continue  # already connected to this node
+                    if now - last < DIAL_BACKOFF:
+                        continue
+                    dialed[enr.node_id] = (now, None)
+                    try:
+                        pc = await self.host.connect(ep[0], int.from_bytes(tcp, "big"))
+                        dialed[enr.node_id] = (now, pc.peer_id)
+                    except Exception:
+                        continue
+                    if len(self.host.peers()) >= self.target_peers:
+                        break
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                self.log.debug(f"discovery loop error: {e!r}")
+            await asyncio.sleep(interval)
+
     async def stop(self) -> None:
+        if self._discovery_task is not None:
+            self._discovery_task.cancel()
+            self._discovery_task = None
+        if self.discv5 is not None:
+            await self.discv5.stop()
+            self.discv5 = None
         # goodbye to connected peers (reference goodbyeAndDisconnectAllPeers)
         for peer in list(self.host.peers()):
             try:

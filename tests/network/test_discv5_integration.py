@@ -1,0 +1,121 @@
+"""discv5 -> libp2p integration: nodes advertise their TCP endpoint +
+fork digest in ENRs; a node that only knows the DHT bootnode discovers
+a third node and dials its libp2p port (reference peers/discover.ts
+over the discv5 worker)."""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.config import minimal_chain_config
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.network.service import Libp2pBeaconNetwork
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+class _NodeStub:
+    def __init__(self):
+        self.pushed = []
+
+    def on_gossip(self, kind, msg, peer=""):
+        self.pushed.append((kind, peer))
+        return True
+
+
+def _mk_chain(p):
+    far = 2**64 - 1
+    cfg = minimal_chain_config().replace(
+        ALTAIR_FORK_EPOCH=far, BELLATRIX_FORK_EPOCH=far,
+        CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far,
+    )
+    genesis = create_interop_genesis_state(N, p=p)
+    return BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        cfg=cfg,
+        current_slot=1,
+    )
+
+
+def test_discv5_drives_libp2p_dials(minimal_preset):
+    async def run():
+        p = minimal_preset
+        nets = []
+        try:
+            # B: the DHT bootnode
+            b = Libp2pBeaconNetwork(
+                node=_NodeStub(), chain=_mk_chain(p), discv5_port=0
+            )
+            nets.append(b)
+            await b.start()
+
+            # C: joins the DHT via B (no libp2p bootnodes at all)
+            c = Libp2pBeaconNetwork(
+                node=_NodeStub(), chain=_mk_chain(p),
+                discv5_port=0, discv5_bootnodes=[b.discv5.enr],
+            )
+            nets.append(c)
+            await c.start()
+
+            # A: also only knows the DHT bootnode
+            a = Libp2pBeaconNetwork(
+                node=_NodeStub(), chain=_mk_chain(p),
+                discv5_port=0, discv5_bootnodes=[b.discv5.enr],
+            )
+            nets.append(a)
+            await a.start()
+
+            # discovery loops run every 5s; drive them directly instead
+            for _ in range(30):
+                for net in (b, c, a):
+                    await net.discv5.bootstrap(rounds=1)
+                if (
+                    c.host.peer_id in a.host.peers()
+                    and b.host.peer_id in a.host.peers()
+                ):
+                    break
+                # one manual discovery pass (same logic the loop runs)
+                for net in (a, c):
+                    digest = net.current_fork_digest()
+                    for enr in net.discv5.enr_source():
+                        if enr.node_id == net.discv5.node_id:
+                            continue
+                        tcp = enr.pairs.get(b"tcp")
+                        ep = enr.udp_endpoint
+                        if not tcp or ep is None:
+                            continue
+                        try:
+                            await net.host.connect(ep[0], int.from_bytes(tcp, "big"))
+                        except Exception:
+                            pass
+                await asyncio.sleep(0.1)
+
+            # A discovered C through the DHT and holds a live libp2p
+            # connection (noise+mplex) to it
+            assert c.host.peer_id in a.host.peers(), "A never dialed C"
+            assert b.host.peer_id in a.host.peers(), "A never dialed B"
+            # and the ENRs carried the right fork digest
+            assert any(
+                e.pairs.get(b"eth2") == a.current_fork_digest()
+                for e in a.discv5.enr_source()
+                if e.node_id != a.discv5.node_id
+            )
+        finally:
+            for net in nets:
+                await net.stop()
+
+    asyncio.run(run())

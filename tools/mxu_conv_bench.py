@@ -48,13 +48,21 @@ def band3(nl):
 
 
 def bench(name, fn, a, b, iters=3):
+    # feed each conv's output back into the next iteration's operand —
+    # K identical pure calls would be common-subexpression-eliminated to
+    # ONE conv + K adds, timing the adds instead of the conv
     @jax.jit
     def f(x, y):
-        out = None
+        nl = x.shape[1]
         for _ in range(K):
             r = fn(x, y)
-            out = r if out is None else out + r
-        return out[0, :1].astype(jnp.float32)
+            if x.dtype == jnp.int8:
+                x = (x + r[:, :nl].astype(jnp.int8)) & 63
+            elif x.dtype == jnp.int32:
+                x = (x + r[:, :nl].astype(jnp.int32)) & 0xFFF
+            else:
+                x = jnp.mod(x + r[:, :nl].astype(x.dtype), jnp.asarray(256, x.dtype))
+        return x[0, :1].astype(jnp.float32)
 
     np.asarray(f(a, b))
     t0 = time.perf_counter()
@@ -90,15 +98,9 @@ def conv_bf16_einsum(x, y):
 bench("bf16 48x8 einsum bi,bj,ijk->bk", conv_bf16_einsum, a48, b48)
 
 
-def conv_bf16_outer(x, y):
-    outer = (x[:, :, None] * y[:, None, :]).reshape(B, 48 * 48)
-    return jnp.dot(
-        outer, jnp.asarray(band(48)).astype(jnp.bfloat16),
-        preferred_element_type=jnp.float32,
-    )
-
-
-bench("bf16 48x8 outer+band dot", conv_bf16_outer, a48, b48)
+# NOTE: an outer+band variant in bf16 would materialize 16-bit products
+# in bf16 (8 significand bits) and is NOT exact — only the einsum form
+# (f32 accumulation) preserves exactness, so only it is benchmarked.
 
 # int8 55x7 einsum
 a55 = jnp.asarray(rng.integers(0, 128, size=(B, 55), dtype=np.int8))
