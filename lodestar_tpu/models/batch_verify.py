@@ -58,6 +58,11 @@ __all__ = [
 
 COEFF_BITS = 64  # blinding scalar width, matches blst's 64-bit rand coeffs
 
+# sharded-program executables are compiled once per (mesh, batch) with
+# the persistent cache disabled — see device_batch_verify_sharded
+_SHARDED_JIT_CACHE: dict = {}
+_SHARDED_COMPILE_LOCK = __import__("threading").Lock()
+
 
 def _fp_to_mont_host(xs: list[int]) -> np.ndarray:
     """Pure-numpy mont conversion: host prep must never bounce arrays
@@ -301,7 +306,33 @@ def device_batch_verify_sharded(mesh, pk, h, sig, coeff_bits, mask) -> jax.Array
         fn = shard_map(
             shard_fn, mesh=mesh, in_specs=specs, out_specs=P("data"), check_rep=False
         )
-    ok = jax.jit(fn)(
+    # persistent-cache serialization of SHARDED executables segfaults
+    # intermittently in this jax build (observed twice in
+    # compilation_cache.put_executable_and_time), so these programs
+    # compile with the persistent cache off — and the jitted callable is
+    # memoized per (mesh, batch size) so each process compiles ONCE and
+    # repeat calls hit jax's in-memory executable cache. The flag flip
+    # is lock-guarded: a concurrent compile on another thread must not
+    # observe (or clobber) the temporary disable.
+    key = (tuple(d.id for d in mesh.devices.flat), pk[0].shape[0])
+    jitted = _SHARDED_JIT_CACHE.get(key)
+    if jitted is None:
+        with _SHARDED_COMPILE_LOCK:
+            jitted = _SHARDED_JIT_CACHE.get(key)
+            if jitted is None:
+                prev_cache = jax.config.jax_enable_compilation_cache
+                jax.config.update("jax_enable_compilation_cache", False)
+                try:
+                    jitted = jax.jit(fn)
+                    # trigger compile inside the guarded window
+                    jitted(
+                        pk[0], pk[1], h[0], h[1], sig[0], sig[1],
+                        jnp.asarray(coeff_bits), jnp.asarray(mask),
+                    )
+                finally:
+                    jax.config.update("jax_enable_compilation_cache", prev_cache)
+                _SHARDED_JIT_CACHE[key] = jitted
+    ok = jitted(
         pk[0], pk[1], h[0], h[1], sig[0], sig[1],
         jnp.asarray(coeff_bits), jnp.asarray(mask),
     )

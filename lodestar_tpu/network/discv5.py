@@ -38,6 +38,7 @@ import time
 
 from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
     decode_dss_signature,
     encode_dss_signature,
 )
@@ -118,8 +119,10 @@ class Enr:
         return items
 
     def sign(self, private_key) -> None:
+        # EIP-778 v4: the secp256k1 signature is over keccak256(content)
+        # DIRECTLY (Prehashed — no second hash)
         digest = keccak256(rlp_encode(self._content()))
-        der = private_key.sign(digest, ec.ECDSA(SHA256()))
+        der = private_key.sign(digest, ec.ECDSA(Prehashed(SHA256())))
         self.signature = _compact_sig(der)
 
     def verify(self) -> bool:
@@ -131,7 +134,7 @@ class Enr:
                 ec.SECP256K1(), pub_bytes
             )
             digest = keccak256(rlp_encode(self._content()))
-            pub.verify(_der_sig(self.signature), digest, ec.ECDSA(SHA256()))
+            pub.verify(_der_sig(self.signature), digest, ec.ECDSA(Prehashed(SHA256())))
             return True
         except Exception:
             return False
@@ -231,6 +234,7 @@ class Discv5Node:
         self._unanswered: dict[bytes, tuple[bytes, tuple]] = {}
         #   nonce -> (plaintext message to retry, addr)
         self._waiters: dict[bytes, asyncio.Future] = {}  # request-id -> future
+        self._fail_counts: dict[bytes, int] = {}  # node id -> consecutive dead sweeps
         self._transport = None
         self._refresh_task: asyncio.Task | None = None
         self.log = get_logger(name="lodestar.discv5")
@@ -320,6 +324,13 @@ class Discv5Node:
         iv = os.urandom(16)
         header = self._header(FLAG_MESSAGE, nonce, self.node_id)
         ct = AESGCM(sess.send_key).encrypt(nonce, message, iv + header)
+        # remember the nonce: if the peer lost its session (restart), it
+        # answers WHOAREYOU and _on_whoareyou both drops our stale
+        # session and re-handshakes with this same message
+        if len(self._unanswered) > 256:
+            for k in list(self._unanswered)[:128]:
+                del self._unanswered[k]
+        self._unanswered[nonce] = (message, addr)
         self._transport.sendto(iv + _mask(dest, iv, header) + ct, addr)
 
     async def _request(self, enr: Enr, message: bytes, request_id: bytes, timeout=3.0):
@@ -353,6 +364,8 @@ class Discv5Node:
         )
         if dest is None:
             return
+        # any WHOAREYOU for this peer invalidates a stale session
+        self.sessions.pop(dest, None)
         enr = self.table[dest]
         challenge_data = iv + header
         eph = ec.generate_private_key(ec.SECP256K1())
@@ -475,7 +488,9 @@ class Discv5Node:
                 if log2_distance(self.node_id, nid) in distances
             ]
             if 0 in distances:
-                found.append(self.enr.encode())
+                # explicitly-requested own record goes FIRST so the
+                # response cap can never drop it
+                found.insert(0, self.enr.encode())
             nodes = bytes([MSG_NODES]) + rlp_encode([req_id, b"\x01", found[:16]])
             enr = self.table.get(src_id)
             if enr is not None:
@@ -531,14 +546,26 @@ class Discv5Node:
         distance >= 253 from anything with ~94% probability) plus our own
         distance to the target, which is how the neighborhood fills.
         Returns the table size."""
-        for b in list(self.bootnodes):
-            await self.ping(b)
+        await asyncio.gather(*(self.ping(b) for b in list(self.bootnodes)))
         for _ in range(rounds):
             targets = list(self.table.values())
-            for enr in targets:
+
+            async def sweep(enr):
                 dist = log2_distance(self.node_id, enr.node_id)
                 distances = sorted({256, 255, 254, 253, dist, max(1, dist - 1)})
-                await self.find_node(enr, distances)
+                got = await self.find_node(enr, distances)
+                # evict entries that repeatedly never answer — dead ENRs
+                # would otherwise add a full timeout to every pass forever
+                nid = enr.node_id
+                if not got and nid not in (b.node_id for b in self.bootnodes):
+                    self._fail_counts[nid] = self._fail_counts.get(nid, 0) + 1
+                    if self._fail_counts[nid] >= 3:
+                        self.table.pop(nid, None)
+                        self._fail_counts.pop(nid, None)
+                else:
+                    self._fail_counts.pop(nid, None)
+
+            await asyncio.gather(*(sweep(e) for e in targets))
         return len(self.table)
 
     def enr_source(self):

@@ -31,6 +31,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import fp
 
+# This kernel hard-codes the 12-bit x 32-limb layout (window offsets,
+# carry masks). If the fp core ever changes limb geometry, mont_mul()
+# refuses to run rather than silently computing the wrong field.
+_LAYOUT_CURRENT = fp.LIMB_BITS == 12 and fp.LIMBS == 32
+
 BLOCK = 256  # batch rows per grid step (sublanes; VMEM-budget bound)
 LANES = 128  # scratch row width; operands live in lanes 64..95
 
@@ -143,6 +148,11 @@ def _mont_mul_flat(a, b, interpret=False):
 
 def mont_mul(a, b, *, interpret: bool = False):
     """Drop-in mont_mul over arbitrary leading batch dims."""
+    if not _LAYOUT_CURRENT:
+        raise NotImplementedError(
+            "fp_pallas targets the retired 12-bit x 32-limb layout; port "
+            "the window/carry constants to the 48x8 core before use"
+        )
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape).reshape(-1, 32)
     b = jnp.broadcast_to(b, shape).reshape(-1, 32)

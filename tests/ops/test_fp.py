@@ -76,12 +76,13 @@ class TestAddSubNeg:
     def test_carry_ripple_many_patterns(self):
         # Sweep ripple chains of every length ending at each limb position.
         pats_a, pats_b = [], []
-        for ln in range(1, 31):
-            la = np.zeros(32, dtype=np.int64)
-            lb = np.zeros(32, dtype=np.int64)
-            la[:ln] = 2048
-            lb[0] = 2048
-            lb[1:ln] = 2047
+        half = (fp.LIMB_MASK + 1) // 2
+        for ln in range(1, fp.LIMBS - 1):
+            la = np.zeros(fp.LIMBS, dtype=np.int64)
+            lb = np.zeros(fp.LIMBS, dtype=np.int64)
+            la[:ln] = half
+            lb[0] = half
+            lb[1:ln] = half - 1
             pats_a.append(fp.int_from_limbs(la))
             pats_b.append(fp.int_from_limbs(lb))
         got = np.asarray(fp.add(fp_to_dev(pats_a), fp_to_dev(pats_b)))
