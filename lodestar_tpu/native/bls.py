@@ -127,9 +127,9 @@ def prepare_sets_native(pubkeys: list[bytes], messages: list[bytes], signatures:
     msgs = np.frombuffer(b"".join(messages), dtype=np.uint8)
     if pks.size != 48 * n or sigs.size != 96 * n or msgs.size != 32 * n:
         return None
-    pk_out = np.empty((n, 2, 32), dtype=np.int32)
-    h_out = np.empty((n, 2, 2, 32), dtype=np.int32)
-    sig_out = np.empty((n, 2, 2, 32), dtype=np.int32)
+    pk_out = np.empty((n, 2, 33), dtype=np.int32)
+    h_out = np.empty((n, 2, 2, 33), dtype=np.int32)
+    sig_out = np.empty((n, 2, 2, 33), dtype=np.int32)
     rc = lib.bls_prepare_sets(
         ctypes.c_uint64(n), _u8(pks), _u8(sigs), _u8(msgs),
         _i32(pk_out), _i32(h_out), _i32(sig_out), 0,

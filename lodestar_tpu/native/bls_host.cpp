@@ -211,16 +211,18 @@ static void fp_to_be48(uint8_t* out, const fp a_mont) {
       out[(5 - i) * 8 + j] = (uint8_t)(v[i] >> (56 - 8 * j));
 }
 
-// mont fp -> 32 x int32 12-bit limbs (device layout; the mont VALUE is
-// split, matching ops/fp.py mont_limbs_from_int)
+// mont fp -> 33 x int32 12-bit limbs (device layout R = 2^396; matches
+// ops/fp.py mont_limbs_from_int). The internal CIOS base is R64 = 2^384,
+// so one extra Montgomery multiply by the raw constant 2^396 mod p turns
+// x*2^384 into the plain words of x*2^396 mod p, which are then split.
 static void fp_to_device_limbs(int32_t* out, const fp a_mont) {
-  // device limbs hold the Montgomery-form value itself; a_mont IS that
-  // value in canonical 6x64 form — split it directly
+  fp v;
+  fp_mul(v, a_mont, FP_C396);  // = x * 2^396 mod p, canonical 6x64 words
   int bitpos = 0;
-  for (int i = 0; i < 32; i++) {
+  for (int i = 0; i < 33; i++) {
     int word = bitpos >> 6, off = bitpos & 63;
-    uint64_t limb = a_mont[word] >> off;
-    if (off > 52 && word < 5) limb |= a_mont[word + 1] << (64 - off);
+    uint64_t limb = word < 6 ? (v[word] >> off) : 0;
+    if (off > 52 && word < 5) limb |= v[word + 1] << (64 - off);
     out[i] = (int32_t)(limb & 0xFFF);
     bitpos += 12;
   }
@@ -937,14 +939,14 @@ static int g2_decompress(g2p& out, const uint8_t in[96]) {
 
 static void fp2_to_device_limbs(int32_t* out, const fp2& a) {
   fp_to_device_limbs(out, a.c0);
-  fp_to_device_limbs(out + 32, a.c1);
+  fp_to_device_limbs(out + 33, a.c1);
 }
 
 extern "C" {
 
 // Prepare one signature set: decompress+subgroup-check pubkey (48B) and
 // signature (96B), hash the 32-byte message to G2. Writes device-layout
-// mont limbs: pk_xy (2*32 int32), h_xy (2*2*32), sig_xy (2*2*32).
+// mont limbs: pk_xy (2*33 int32), h_xy (2*2*33), sig_xy (2*2*33).
 // Returns 0 on success, nonzero error code otherwise (infinity pubkey or
 // signature is an error here, matching prepare_sets' fail-fast).
 int bls_prepare_one(const uint8_t* pk48, const uint8_t* sig96, const uint8_t* msg,
@@ -968,11 +970,11 @@ int bls_prepare_one(const uint8_t* pk48, const uint8_t* sig96, const uint8_t* ms
   g2_to_affine(hx, hy, h);
 
   fp_to_device_limbs(pk_out, pk.X);
-  fp_to_device_limbs(pk_out + 32, pk.Y);
+  fp_to_device_limbs(pk_out + 33, pk.Y);
   fp2_to_device_limbs(h_out, hx);
-  fp2_to_device_limbs(h_out + 64, hy);
+  fp2_to_device_limbs(h_out + 66, hy);
   fp2_to_device_limbs(sig_out, sig.X);
-  fp2_to_device_limbs(sig_out + 64, sig.Y);
+  fp2_to_device_limbs(sig_out + 66, sig.Y);
   return 0;
 }
 
@@ -994,7 +996,7 @@ int bls_prepare_sets(uint64_t n, const uint8_t* pks, const uint8_t* sigs,
       uint64_t i = next.fetch_add(1);
       if (i >= n || bad.load() >= 0) return;
       int rc = bls_prepare_one(pks + 48 * i, sigs + 96 * i, msgs + 32 * i, 32,
-                               pk_out + 64 * i, h_out + 128 * i, sig_out + 128 * i);
+                               pk_out + 66 * i, h_out + 132 * i, sig_out + 132 * i);
       if (rc != 0) {
         int64_t expect = -1;
         int64_t mine = (int64_t)i;

@@ -6,17 +6,30 @@ per step, which on device would serialize the batch; Jacobian doubling and
 mixed addition are inversion-free, so every step is pure vectorized
 mul/add over the limb arrays and the whole batch advances in lockstep.
 
-Points are (X, Y, Z) tuples of mont-form limb arrays; Z == 0 encodes
-infinity. Generic over the field through a tiny namespace (`F1`/`F2`),
-since the a=0 short-Weierstrass formulas are identical for both groups.
+Points are (X, Y, Z) tuples of mont-form limb arrays. **Infinity is the
+exact-zero Z limb pattern** (all limbs 0) — the relaxed field core
+(ops/fp.py round-5 redesign) preserves exact zeros through products, so
+infinity created by padding or by `zero_pt` propagates for free.
+Exceptional-case handling comes in two flavors:
+
+* fast (default for the blinded hot paths): only exact-zero infinity
+  selects. P == ±Q collisions are *unreachable* there — the running
+  accumulator is k*Q for a k that is never ±1 mod ord(Q) (blinding
+  scalars and |x|-prefixes are < 2^65 << r, and inputs are
+  subgroup-checked so ord(Q) = r).
+* exact (`jac_add`, `scalar_mul_const`): value-level zero tests
+  (`fp.is_zero_mod`, one reduction + one scan each) drive the P == Q /
+  P == -Q selects, and a detected cancellation canonicalizes the result
+  to the exact-zero infinity form — required by MSM/KZG (data-dependent
+  scalars) and by subgroup checks (multiplying by r lands on -Q + Q at
+  the last addition).
 
 Scalar multiplication comes in two shapes mirroring how the verifier uses
 it (reference batch verify `maybeBatch.ts:16-38`):
   * `scalar_mul_var`: per-element runtime scalars (the random blinding
     coefficients of batch verification) — bit matrix input, select-based.
   * `scalar_mul_const`: one static scalar (subgroup checks by r, cofactor
-    clearing by h_eff) — lax.scan over the static bit array with cond'd
-    add steps, so the compiled body is one double + one optional add.
+    clearing by h_eff) — lax.scan over the static bit array.
 """
 
 from __future__ import annotations
@@ -31,8 +44,8 @@ from . import fp
 from . import tower as tw
 
 __all__ = ["F1", "F2", "jac_double", "jac_add_mixed", "jac_add", "jac_is_inf",
-           "jac_to_affine_batch", "scalar_mul_var", "scalar_mul_const",
-           "jac_neg", "affine_to_jac", "fold_sum"]
+           "jac_is_inf_val", "jac_to_affine_batch", "scalar_mul_var",
+           "scalar_mul_const", "jac_neg", "affine_to_jac", "fold_sum"]
 
 
 class _FieldOps:
@@ -40,16 +53,21 @@ class _FieldOps:
     are valid jit static arguments — SimpleNamespace is not (it defines
     `__eq__`, which drops `__hash__`)."""
 
-    __slots__ = ("mul", "sq", "add", "sub", "neg", "is_zero", "inv")
+    __slots__ = ("mul", "sq", "add", "sub", "neg", "is_zero", "is_zero_mod", "inv")
 
-    def __init__(self, *, mul, sq, add, sub, neg, is_zero, inv):
+    def __init__(self, *, mul, sq, add, sub, neg, is_zero, is_zero_mod, inv):
         self.mul = mul
         self.sq = sq
         self.add = add
         self.sub = sub
         self.neg = neg
         self.is_zero = is_zero
+        self.is_zero_mod = is_zero_mod
         self.inv = inv
+
+
+def _fp2_is_zero_mod(a):
+    return fp.is_zero_mod(a[..., 0, :]) & fp.is_zero_mod(a[..., 1, :])
 
 
 F1 = _FieldOps(
@@ -59,6 +77,7 @@ F1 = _FieldOps(
     sub=fp.sub,
     neg=fp.neg,
     is_zero=fp.is_zero,
+    is_zero_mod=fp.is_zero_mod,
     inv=fp.inv,
 )
 F2 = _FieldOps(
@@ -68,6 +87,7 @@ F2 = _FieldOps(
     sub=tw.fp2_sub,
     neg=tw.fp2_neg,
     is_zero=tw.fp2_is_zero,
+    is_zero_mod=_fp2_is_zero_mod,
     inv=tw.fp2_inv,
 )
 
@@ -77,7 +97,15 @@ def _dbl(F, x):
 
 
 def jac_is_inf(F, pt):
+    """Exact-zero infinity test (the maintained encoding)."""
     return F.is_zero(pt[2])
+
+
+def jac_is_inf_val(F, pt):
+    """Value-level infinity test (Z == 0 mod p) — boundary predicates
+    where a cancellation may have produced a relaxed zero (aggregate
+    fold results, fast-path scalar-multiple outputs)."""
+    return F.is_zero_mod(pt[2])
 
 
 def jac_neg(F, pt):
@@ -91,7 +119,8 @@ def affine_to_jac(F, xy, one):
 
 
 def jac_double(F, pt):
-    """2P for a = 0 curves. Infinity (Z=0) stays infinity (Z3 = 2YZ = 0)."""
+    """2P for a = 0 curves. Infinity (exact-zero Z) stays exactly infinite
+    (Z3 = 2*Y*Z keeps the zero limb pattern through mul/add)."""
     X, Y, Z = pt
     A = F.sq(X)
     B = F.sq(Y)
@@ -118,13 +147,20 @@ def _where_pt(F, cond, a, b):
     return tuple(sel(u, v) for u, v in zip(a, b))
 
 
-def jac_add_mixed(F, pt, q_aff, one):
+def _zero_pt_like(x):
+    return (jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros_like(x))
+
+
+def jac_add_mixed(F, pt, q_aff, one, exact: bool = False):
     """P (Jacobian) + Q (affine, not infinity).
 
-    Complete for the batch-verify flows: handles P = inf, P = -Q (gives
-    inf via Z3 = Z1*H = 0), and the exceptional P = Q case (falls back to
-    doubling via select).
-    """
+    Handles P = inf (exact-zero Z). With `exact=True` it also handles
+    P = Q (doubling select) and P = -Q (canonical exact-zero infinity
+    result) via value-level zero tests — needed when the accumulated
+    scalar can hit ±1 mod ord(Q) (subgroup checks by r, cofactor
+    clearing of points outside the r-subgroup). The fast default skips
+    those: blinded 64-bit scalars and Miller |x|-prefixes can't reach
+    them (module docstring)."""
     X1, Y1, Z1 = pt
     xq, yq = q_aff
     Z1Z1 = F.sq(Z1)
@@ -140,17 +176,27 @@ def jac_add_mixed(F, pt, q_aff, one):
     Z3 = F.mul(Z1, H)
     out = (X3, Y3, Z3)
 
-    # P == Q (H = 0, r = 0): correct result is 2Q
-    is_dbl = F.is_zero(H) & F.is_zero(r) & ~F.is_zero(Z1)
     q_jac = affine_to_jac(F, q_aff, one)
-    out = _where_pt(F, is_dbl, jac_double(F, q_jac), out)
+    if exact:
+        finite = ~F.is_zero(Z1)
+        h0 = F.is_zero_mod(H)
+        r0 = F.is_zero_mod(r)
+        # P == Q: correct result is 2Q; P == -Q: exact-zero infinity
+        out = _where_pt(F, h0 & r0 & finite, jac_double(F, q_jac), out)
+        out = _where_pt(F, h0 & ~r0 & finite, _zero_pt_like(X3), out)
     # P == inf: result is Q
     out = _where_pt(F, F.is_zero(Z1), q_jac, out)
     return out
 
 
-def jac_add(F, p1, p2):
-    """Full Jacobian + Jacobian addition with completeness selects."""
+def jac_add(F, p1, p2, exact: bool = True):
+    """Full Jacobian + Jacobian addition.
+
+    exact=True (default): complete — value-level tests drive the P == Q
+    doubling select and canonicalize P == -Q to exact-zero infinity
+    (MSM/KZG correctness with data-dependent scalars). exact=False keeps
+    only the exact-zero infinity selects (blinded fold trees, where a
+    collision has probability ~2^-64 and a wrong verdict is re-tried)."""
     X1, Y1, Z1 = p1
     X2, Y2, Z2 = p2
     Z1Z1 = F.sq(Z1)
@@ -169,30 +215,34 @@ def jac_add(F, p1, p2):
     Z3 = F.mul(H, F.mul(Z1, Z2))
     out = (X3, Y3, Z3)
 
-    is_dbl = F.is_zero(H) & F.is_zero(r) & ~F.is_zero(Z1) & ~F.is_zero(Z2)
-    out = _where_pt(F, is_dbl, jac_double(F, p1), out)
+    if exact:
+        finite = ~F.is_zero(Z1) & ~F.is_zero(Z2)
+        h0 = F.is_zero_mod(H)
+        r0 = F.is_zero_mod(r)
+        out = _where_pt(F, h0 & r0 & finite, jac_double(F, p1), out)
+        out = _where_pt(F, h0 & ~r0 & finite, _zero_pt_like(X3), out)
     out = _where_pt(F, F.is_zero(Z1), p2, out)
     out = _where_pt(F, F.is_zero(Z2), p1, out)
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def scalar_mul_var(F, q_aff, bit_matrix, one):
+@functools.partial(jax.jit, static_argnums=(0,), static_argnames=("exact",))
+def scalar_mul_var(F, q_aff, bit_matrix, one, exact: bool = False):
     """Per-element scalar multiples of affine points.
 
     q_aff: batch of affine points; bit_matrix: (B, nbits) int32, MSB first
     (host-prepared from the runtime scalars). Branch-free: the add is
-    always computed and selected per element. Jitted with the field
-    namespace static (F1/F2 are module singletons).
-    """
+    always computed and selected per element. The fast default addition
+    is sound for <2^64 blinding scalars (module docstring); pass
+    exact=True for full-width data scalars (MSM/KZG), where a prefix can
+    legitimately hit ±1 mod r."""
     bit_matrix = jnp.asarray(bit_matrix)  # accept host numpy input under jit
     nbits = bit_matrix.shape[-1]
-    x = q_aff[0]
-    zero_pt = (jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros_like(x))
+    zero_pt = _zero_pt_like(q_aff[0])
 
     def body(acc, j):
         acc = jac_double(F, acc)
-        added = jac_add_mixed(F, acc, q_aff, one)
+        added = jac_add_mixed(F, acc, q_aff, one, exact=exact)
         bit = bit_matrix[..., j] != 0
         return _where_pt(F, bit, added, acc), None
 
@@ -205,22 +255,21 @@ def scalar_mul_const(F, q_aff, scalar: int, one):
     """Static-scalar multiples (subgroup check by r, h_eff clearing).
 
     One compiled double + cond'd add per bit via lax.scan over the static
-    bit array; both branches compile once regardless of scalar length.
-    """
+    bit array. Uses the exact (complete) addition: multiplying by r walks
+    through -Q + Q at the final addition, and cofactor-clearing inputs
+    may have small order."""
     if scalar == 0:
-        x = q_aff[0]
-        return (jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros_like(x))
+        return _zero_pt_like(q_aff[0])
     bits = jnp.asarray(
         np.array([int(b) for b in bin(scalar)[2:]], dtype=np.int32)
     )
-    x = q_aff[0]
-    zero_pt = (jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros_like(x))
+    zero_pt = _zero_pt_like(q_aff[0])
 
     def body(acc, bit):
         acc = jac_double(F, acc)
         acc = jax.lax.cond(
             bit != 0,
-            lambda a: jac_add_mixed(F, a, q_aff, one),
+            lambda a: jac_add_mixed(F, a, q_aff, one, exact=True),
             lambda a: a,
             acc,
         )
@@ -235,8 +284,9 @@ def fold_sum(F, pts):
     """Sum a batch of Jacobian points down the batch axis (tree fold).
 
     pts: (X, Y, Z) each (B, ...). Returns a single point with batch dims
-    removed. B is padded to a power of two with infinity.
-    """
+    removed. B is padded to a power of two with exact-zero infinity.
+    Uses the complete addition (cancellations inside an aggregate are
+    legitimate data, e.g. equal-and-opposite blinded signatures)."""
     X, Y, Z = pts
     b = X.shape[0]
     size = 1 if b <= 1 else 1 << (b - 1).bit_length()
@@ -257,13 +307,9 @@ def jac_to_affine_batch(F, pt):
     """Jacobian -> affine for a batch (per-element field inversion, fully
     vectorized: the Fermat chain runs once across the whole batch).
 
-    Infinity maps to (0, 0) — callers must mask with jac_is_inf.
-    """
+    Infinity maps to garbage coordinates — callers must mask with
+    jac_is_inf / jac_is_inf_val."""
     X, Y, Z = pt
-    zinv = F.inv(F.add(Z, _zero_like_guard(F, Z)))  # guard handled by caller
+    zinv = F.inv(Z)
     zinv2 = F.sq(zinv)
     return (F.mul(X, zinv2), F.mul(Y, F.mul(zinv, zinv2)))
-
-
-def _zero_like_guard(F, z):
-    return jnp.zeros_like(z)

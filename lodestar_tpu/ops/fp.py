@@ -1,4 +1,4 @@
-"""Batched BLS12-381 base-field arithmetic on TPU (JAX).
+"""Batched BLS12-381 base-field arithmetic on TPU (JAX) — relaxed form.
 
 The device counterpart of the functional CPU oracle
 `lodestar_tpu.crypto.bls.fields` (designed for 1:1 differential testing —
@@ -6,23 +6,50 @@ see that module's docstring). Replaces the blst C field layer the
 reference binds via `@chainsafe/bls`
 (`packages/beacon-node/src/chain/bls/maybeBatch.ts:18`).
 
-Representation (tpu-first):
+Representation (tpu-first, round-5 redesign):
 
-* An Fp element is 32 little-endian limbs of 12 bits in int32 lanes,
-  shape (..., 32), value canonical (< p) with 12-bit-clean limbs at API
-  boundaries. 12-bit limbs keep every intermediate of a 32x32 schoolbook
-  product + Montgomery reduction strictly inside int32 (max ~2^30), so the
-  whole field stack runs on the VPU with no emulated 64-bit arithmetic.
-* Elements live in Montgomery form (R = 2^384) between `to_mont` /
-  `from_mont`. Multiplication is a polynomial (convolution) product
-  built from 32 shifted fused multiply-adds, followed by a SEPARATED
-  Montgomery reduction (m = t_lo * P' mod R in one triangular conv, then
-  (t + m*p)/R) whose carries resolve in three data-parallel passes — no
-  per-limb sequential loop anywhere in the multiply (see `_mont_redc`).
-  Sequential work per multiply is one exact carry scan + one conditional
-  subtract for the canonical-output contract.
-* All public ops are shape-polymorphic over leading batch dims and safe
-  under jit/vmap/shard_map.
+* An Fp element is **33** little-endian limbs of 12 bits in int32 lanes,
+  shape (..., 33). R = 2^396, so R/p ~ 2^14.8 — that deliberate headroom
+  (vs the minimal 32-limb R = 2^384 of rounds 1-4) is what makes the
+  whole stack *scan-free*:
+
+  - **Relaxed, signed contract.** Values lie in (-2.1p, 2.2p)
+    (Montgomery outputs in (-0.001p, 1.03p)); limbs are SIGNED with
+    |limb| <= ~2^12+70. No canonical (< p) contract between ops, so the
+    per-op sequential carry scan + conditional-subtract borrow scan of
+    the r4 core are GONE from the hot path. `canon()` restores the
+    canonical form at program boundaries only.
+  - **Accumulator domain.** A product a*b lives as a 66-limb accumulator
+    (`mul_acc`); accumulators ADD/SUB for free (elementwise, signed), and
+    one Montgomery reduction (`redc`) serves a whole *sum* of products. The tower
+    (ops/tower.py) exploits this to cut reductions per Fp12 multiply
+    from 54 to 12 — the dispatch x HBM-round-trip budget that r4 proved
+    is the binding resource (see VERDICT r4 "what's weak" #1).
+  - Montgomery reduction stays the separated two-multiplication form
+    (m = t_lo * P' mod R; (t + m p)/R) with three data-parallel
+    conv/carry steps. Signed inputs are handled by adding the constant
+    2*R*p before the division and subtracting 2p after — value-neutral
+    mod p, keeps the quotient positive, and maps an exact-zero input to
+    an exact-zero output. The low half s_lo is a multiple of R in
+    (-0.02R, 1.02R), i.e. exactly 0 or R; its limb 32 is <= 1 in the
+    zero case and >= 4095 in the R case, so the carry is the single-limb
+    threshold test s_lo[32] >= 2048.
+
+* **Exact zero** (all limbs 0) is preserved by mul/redc (conv(0) = 0,
+  and the 2Rp/R - 2p offsets cancel), which lets Jacobian infinity (Z=0)
+  propagate
+  without canonicalization. `is_zero`/`eq` are *limb-pattern* tests and
+  only meaningful for exact zeros / canonical values; `is_zero_mod`
+  decides value == 0 (mod p) for any relaxed input (one redc + one
+  scan) and is reserved for boundary predicates (subgroup-check
+  infinity, aggregate-is-infinity).
+
+Bounds ledger (int32 safety; all limb bounds are on |limb|):
+  limb bound after 2 carry passes   <= 4095 + 70        (LIMB_LOOSE)
+  conv coefficient                  <= 33 * 4170^2      < 2^30 ✓
+  acc sums (k terms)                limbs <= ~2^15, redc pre-carries
+  redc input value budget           |t| << p*R ~ 30,000 p^2 (we use < ~10^2 p^2)
+  redc output value                 in (-0.001p, |t|/(pR)*p + 1.03p)
 """
 
 from __future__ import annotations
@@ -52,21 +79,29 @@ __all__ = [
     "neg",
     "mont_mul",
     "mont_sq",
+    "mul_acc",
+    "sq_acc",
+    "acc_add",
+    "acc_sub",
+    "redc",
+    "canon",
     "pow_const",
     "inv",
     "is_zero",
+    "is_zero_mod",
     "eq",
 ]
 
 LIMB_BITS = 12
 LIMB_MASK = (1 << LIMB_BITS) - 1
-LIMBS = 32  # 32 * 12 = 384 bits >= 381
+LIMBS = 33  # 33 * 12 = 396 bits; R/p ~ 2^14.8 headroom (module docstring)
+ACC_LIMBS = 2 * LIMBS
 
 # --- host-side conversions --------------------------------------------------
 
 
 def limbs_from_int(x: int) -> np.ndarray:
-    """Python int -> (32,) int32 little-endian 12-bit limbs."""
+    """Python int -> (33,) int32 little-endian 12-bit limbs."""
     if not 0 <= x < (1 << (LIMBS * LIMB_BITS)):
         raise ValueError("value out of limb range")
     return np.array([(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(LIMBS)], dtype=np.int32)
@@ -78,15 +113,15 @@ def int_from_limbs(limbs) -> int:
 
 
 def limbs_from_ints(xs) -> np.ndarray:
-    """List of ints -> (N, 32) int32."""
+    """List of ints -> (N, 33) int32."""
     return np.stack([limbs_from_int(x) for x in xs])
 
 
 def mont_limbs_from_int(x: int) -> np.ndarray:
-    """Host-side (pure numpy) Montgomery-form limbs of x: mont(x) is just
-    x * 2^384 mod p. The ONE sanctioned way to build mont-form module
-    constants — importing callers must never run the jitted `to_mont`
-    (import-time device compute was the r3 multichip-gate regression)."""
+    """Host-side (pure numpy) Montgomery-form limbs of x: x * 2^396 mod p.
+    The ONE sanctioned way to build mont-form module constants —
+    importing callers must never run the jitted `to_mont` (import-time
+    device compute was the r3 multichip-gate regression)."""
     return limbs_from_int(x * (1 << (LIMBS * LIMB_BITS)) % P)
 
 
@@ -98,14 +133,12 @@ def ints_from_limbs(arr) -> list[int]:
 # --- constants --------------------------------------------------------------
 
 P_LIMBS = limbs_from_int(P)
-R_MOD_P = (1 << (LIMBS * LIMB_BITS)) % P  # 2^384 mod p (the Montgomery "1")
+R_MOD_P = (1 << (LIMBS * LIMB_BITS)) % P  # 2^396 mod p (the Montgomery "1")
 R2_MOD_P = pow(1 << (LIMBS * LIMB_BITS), 2, P)
 ONE_MONT_LIMBS = limbs_from_int(R_MOD_P)
 R2_LIMBS = limbs_from_int(R2_MOD_P)
 
-# Full-width Montgomery factor P' = -P^{-1} mod 2^384 (the separated
-# Montgomery reduction computes m = t_lo * P' mod R in one shot instead of
-# 32 per-limb sequential steps — see _mont_redc).
+# Full-width Montgomery factor P' = -P^{-1} mod 2^396.
 PPRIME_FULL = (-pow(P, -1, 1 << (LIMBS * LIMB_BITS))) % (1 << (LIMBS * LIMB_BITS))
 PPRIME_LIMBS = limbs_from_int(PPRIME_FULL)
 
@@ -121,52 +154,53 @@ def one_mont(batch_shape=()) -> jax.Array:
 # --- carry handling ---------------------------------------------------------
 
 
-def _carry_once(x):
-    """One signed carry-propagation pass over the last axis (no wraparound:
-    callers guarantee the true value fits in 384 bits)."""
+def _carry_once(x, drop_top: bool = False):
+    """One signed carry-propagation pass over the last axis.
+
+    By default the TOP limb is left unnormalized (it only accumulates
+    carry-ins): dropping a top carry would shift the value by k*2^(12n),
+    which is NOT 0 mod p — with signed limbs a small negative value can
+    legitimately carry out of the top (the r5 bug class this guards
+    against). The top limb stays tiny because tracked values are tiny
+    relative to the limb window. drop_top=True restores the dropping
+    behavior for the one site where it IS the semantics: the mod-R
+    truncation of m = t*P' inside `redc`."""
     c = x >> LIMB_BITS  # arithmetic shift == floor div, correct for negatives
+    if not drop_top:
+        zero_top = jnp.zeros_like(c[..., :1])
+        c = jnp.concatenate([c[..., :-1], zero_top], axis=-1)
     lo = x - (c << LIMB_BITS)
     return lo + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
 
 
-def _carry_seq(x):
-    """Exact carry normalization: one sequential 32-step pass with full
-    (multi-bit, possibly negative) carry-in per limb. Unlike repeated
-    `_carry_once` passes — which move a carry *ripple* only one limb per
-    pass and can leave a limb at exactly 2^12 (e.g. limb sums
-    [4096, 4095, 4095, ...]) — this always produces 12-bit-clean limbs,
-    which `_cond_sub_p` / `eq` rely on. The final carry out of limb 31 is
-    dropped: callers guarantee the true value is in [0, 2^384).
+def _carry2(x, drop_top: bool = False):
+    """Two parallel carry passes: |limbs| < 2^30 in -> limbs in
+    [-66, 4095 + 66] (top limb: small, exact) with value preserved.
+    Signed-safe (arithmetic shifts floor)."""
+    return _carry_once(_carry_once(x, drop_top), drop_top)
 
-    Expressed as a lax.scan over the limb axis so each call site costs a
-    handful of graph nodes — the pairing traces thousands of these.
-    """
-    xs = jnp.moveaxis(x, -1, 0)  # (32, ...)
+
+LIMB_LOOSE = LIMB_MASK + 66  # post-_carry2 |limb| bound
+
+
+def _carry_seq(x):
+    """Exact carry normalization (sequential 33-step lax.scan) — boundary
+    use only (`canon`). Produces 12-bit-clean limbs; top carry dropped."""
+    xs = jnp.moveaxis(x, -1, 0)
     carry = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
 
     def step(carry, xi):
         t = xi + carry
-        return t >> LIMB_BITS, t & LIMB_MASK  # arithmetic shift: floor
+        return t >> LIMB_BITS, t & LIMB_MASK
 
     _, out = jax.lax.scan(step, carry, xs)
     return jnp.moveaxis(out, 0, -1)
 
 
-def _carry_full(x, passes: int = 4):
-    """Shrink limb magnitudes with `passes` parallel passes (each pass
-    divides the carry size by 2^12), then run one exact sequential pass so
-    the result is guaranteed 12-bit clean regardless of carry ripples."""
-    for _ in range(passes - 1):
-        x = _carry_once(x)
-    return _carry_seq(x)
-
-
-def _cond_sub_p(x):
-    """x - p if x >= p else x; x must be 12-bit clean. Result clean.
-
-    Borrow propagation as a lax.scan over the limb axis (compact graph —
-    see _carry_seq)."""
-    d = jnp.moveaxis(x - jnp.asarray(P_LIMBS), -1, 0)  # (32, ...)
+def _cond_sub(x, climbs):
+    """x - c if x >= c else x (c a canonical constant); x must be 12-bit
+    clean. Boundary use only."""
+    d = jnp.moveaxis(x - jnp.asarray(climbs), -1, 0)
     borrow0 = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
 
     def step(borrow, di):
@@ -175,39 +209,37 @@ def _cond_sub_p(x):
         return borrow, t + (borrow << LIMB_BITS)
 
     borrow, sub = jax.lax.scan(step, borrow0, d)
-    ge = borrow == 0  # no final borrow => x >= p
+    ge = borrow == 0
     return jnp.where(ge[..., None], jnp.moveaxis(sub, 0, -1), x)
 
 
-# --- public ops -------------------------------------------------------------
+# --- element ops (relaxed) --------------------------------------------------
 
 
 @jax.jit
 def add(a, b):
-    """(a + b) mod p; canonical in, canonical out."""
-    return _cond_sub_p(_carry_full(a + b, passes=2))
+    """a + b (mod-p value); relaxed in, relaxed out (one parallel carry)."""
+    return _carry_once(a + b)
 
 
 @jax.jit
 def sub(a, b):
-    """(a - b) mod p; canonical in, canonical out."""
-    return _cond_sub_p(_carry_full(a + jnp.asarray(P_LIMBS) - b, passes=2))
+    """a - b (signed limbs; value in (-2.1p, 2.2p)); one parallel carry."""
+    return _carry_once(a - b)
 
 
 @jax.jit
 def neg(a):
-    """(-a) mod p. neg(0) must stay 0, so subtract conditionally."""
-    nz = jnp.any(a != 0, axis=-1, keepdims=True)
-    return jnp.where(nz, _cond_sub_p(_carry_full(jnp.asarray(P_LIMBS) - a, passes=2)), a)
+    """-a (signed). Preserves exact zero."""
+    return _carry_once(-a)
 
 
 # Band tensor for the variable-variable polynomial product: one dot
-# against a constant (1024, 64) one-hot map. A 32-term unrolled
-# shifted-FMA formulation was tried and measured runtime-IDENTICAL on the
-# chip while exploding XLA compile time ~5x (the pairing traces thousands
-# of convs; the r4 multichip-gate compile timed out) — the single-dot
-# form keeps graphs small.
-_T_BAND = np.zeros((LIMBS * LIMBS, 2 * LIMBS), dtype=np.int32)
+# against a constant (33^2, 66) one-hot map. (A 33-term unrolled
+# shifted-FMA formulation measured runtime-identical on chip while
+# exploding XLA compile time ~5x — r4 finding; the single-dot form keeps
+# traced graphs small.)
+_T_BAND = np.zeros((LIMBS * LIMBS, ACC_LIMBS), dtype=np.int32)
 for _i in range(LIMBS):
     for _j in range(LIMBS):
         _T_BAND[_i * LIMBS + _j, _i + _j] = 1
@@ -225,82 +257,98 @@ def _band_matrix(climbs, rows: int, cols: int) -> np.ndarray:
     return m
 
 
-_M_PPRIME_LOW = _band_matrix(PPRIME_LIMBS, LIMBS, LIMBS)  # product mod 2^384
-_M_P_FULL = _band_matrix(P_LIMBS, LIMBS, 2 * LIMBS)
+_M_PPRIME_LOW = _band_matrix(PPRIME_LIMBS, LIMBS, LIMBS)  # product mod 2^396
+_M_P_FULL = _band_matrix(P_LIMBS, LIMBS, ACC_LIMBS)
+
+# redc positivity offset 2*R*p (low 33 limbs are exactly zero) and its
+# quotient 2p: redc computes (t + m*p + 2Rp)/R - 2p, which is t*R^{-1}
+# mod p, positive-quotient for signed t, and exactly zero for t == 0.
+_TWO_RP = np.concatenate(
+    [np.zeros(LIMBS, dtype=np.int32), limbs_from_int(2 * P)]
+)
+_TWO_P = limbs_from_int(2 * P)
 
 
 def _conv_pair(a, b):
-    """Polynomial product (.., 32) x (.., 32) -> (.., 64) via the band
-    tensor. Coefficients <= 32 * (2^12-1)^2 < 2^29 (int32-safe)."""
+    """Polynomial product (.., 33) x (.., 33) -> (.., 66) via the band
+    tensor. Coefficients <= 33 * LIMB_LOOSE^2 < 2^30 (int32-safe)."""
     outer = a[..., :, None] * b[..., None, :]
     flat = outer.reshape(*outer.shape[:-2], LIMBS * LIMBS)
     return flat @ jnp.asarray(_T_BAND)
 
 
-def _conv_sq(a):
-    """Polynomial square — same band form (the halved-multiply shifted
-    variant measured no faster on chip; see _conv_pair note)."""
-    return _conv_pair(a, a)
-
-
 def _conv_pprime_low(x) -> jax.Array:
-    """First 32 coefficients of x * P' (the product mod 2^384) as one
-    (.., 32) @ (32, 32) dot. x limbs <= 2^12 -> coefficients < 2^29."""
+    """First 33 coefficients of x * P' (the product mod 2^396) as one
+    (.., 33) @ (33, 33) dot."""
     return x @ jnp.asarray(_M_PPRIME_LOW)
 
 
 def _conv_p_full(x) -> jax.Array:
-    """Full product x * p as (.., 64) coefficients via one dot."""
+    """Full product x * p as (.., 66) coefficients via one dot."""
     return x @ jnp.asarray(_M_P_FULL)
 
 
-def _carry3(x):
-    """Three parallel carry passes: limbs < 2^30 in -> limbs <= 2^12
-    ("loose-clean": 2^12 itself is reachable via carry ripple) with value
-    preserved (the carry out of the top limb is dropped — callers
-    guarantee it is zero for 64-wide inputs and rely on the mod-2^384
-    semantics for 32-wide ones). Carry magnitudes shrink 2^12 per pass:
-    2^17 -> 2^5 -> 1."""
-    return _carry_once(_carry_once(_carry_once(x)))
+# --- accumulator domain -----------------------------------------------------
 
 
-def _mont_redc(t):
-    """Separated Montgomery reduction: (.., 64) accumulator with limbs
-    <= 2^12 (loose-clean) -> canonical (.., 32) t * R^{-1} mod p.
+@jax.jit
+def mul_acc(a, b):
+    """Product accumulator: value(a)*value(b) as 66 loose limbs."""
+    return _carry2(_conv_pair(a, b))
 
-    Classic two-multiplication form (m = t_lo * P' mod R; result =
-    (t + m*p) / R), with every step a data-parallel conv/carry — the
-    original per-limb interleaved reduction serialized 32 heavyweight
-    steps (dynamic 32-wide slice updates) per multiply.
 
-    The division by R needs the carry out of the low half. After _carry3
-    the low half's limbs are <= 2^12, so its value is < 1.0003 * 2^384;
-    since it is a multiple of 2^384 by construction, it is EXACTLY 0 or
-    2^384 — the carry is just the batch predicate any(s_lo != 0). No
-    sequential scan anywhere in the reduction.
-    """
-    m = _carry3(_conv_pprime_low(t[..., :LIMBS]))  # mod 2^384
-    s = _carry3(t + _conv_p_full(m))
-    carry = jnp.any(s[..., :LIMBS] != 0, axis=-1)
+@jax.jit
+def sq_acc(a):
+    return _carry2(_conv_pair(a, a))
+
+
+def acc_add(*ts):
+    """Sum accumulators. Ends with one parallel carry pass so the result's
+    limbs are loose again (safe as a later acc_sub subtrahend)."""
+    out = ts[0]
+    for t in ts[1:]:
+        out = out + t
+    return _carry_once(out)
+
+
+def acc_sub(t, u):
+    """t - u (signed limbs). Ends with one carry pass (loose-limbed,
+    nestable)."""
+    return _carry_once(t - u)
+
+
+@jax.jit
+def redc(t):
+    """Montgomery reduction of a (.., 66) accumulator (or signed sum of
+    accumulators): t * R^{-1} mod p as a relaxed element in
+    (-0.001p, ~1.03p).
+
+    Separated two-multiplication form; all steps data-parallel (module
+    docstring). Computes (t + m*p + 2Rp)/R - 2p: the 2Rp offset keeps the
+    quotient positive for signed t and cancels exactly for t == 0
+    (infinity propagation). The low half s_lo is a multiple of R in
+    (-0.02R, 1.02R) — exactly 0 or R — detected by the single-limb
+    threshold s_lo[32] >= 2048 (<=1 in the 0 case, >=4095 in the R case)."""
+    t = _carry_once(t)  # absorb accumulator sums (limbs <= ~2^15 -> loose)
+    m = _carry2(_conv_pprime_low(t[..., :LIMBS]), drop_top=True)  # mod R
+    s = _carry2(t + _conv_p_full(m) + jnp.asarray(_TWO_RP))
+    carry = s[..., LIMBS - 1] >= 2048
     hi = s[..., LIMBS:]
     hi0 = hi[..., :1] + carry[..., None].astype(jnp.int32)
-    hi = jnp.concatenate([hi0, hi[..., 1:]], axis=-1)  # limbs <= 2^12 + 1
-    # result value < 1.11 p (p^2/R + 1.0003 p): one exact normalize + one
-    # conditional subtract restores the canonical contract.
-    return _cond_sub_p(_carry_seq(hi))
+    hi = jnp.concatenate([hi0, hi[..., 1:]], axis=-1)
+    return _carry_once(hi - jnp.asarray(_TWO_P))
 
 
 @jax.jit
 def mont_mul(a, b):
-    """Montgomery product abR^{-1} mod p; canonical in/out."""
-    return _mont_redc(_carry3(_conv_pair(a, b)))
+    """Montgomery product abR^{-1} mod p; relaxed in/out, exact-zero
+    preserving."""
+    return redc(_carry2(_conv_pair(a, b)))
 
 
 @jax.jit
 def mont_sq(a):
-    """Montgomery square (same conv as mont_mul — a halved-multiply
-    shifted formulation measured no faster on chip)."""
-    return _mont_redc(_carry3(_conv_sq(a)))
+    return redc(_carry2(_conv_pair(a, a)))
 
 
 @jax.jit
@@ -309,12 +357,25 @@ def to_mont(a):
     return mont_mul(a, jnp.asarray(R2_LIMBS))
 
 
+_FOUR_P = limbs_from_int(4 * P)
+
+
+@jax.jit
+def canon(a):
+    """Relaxed signed (|value| < 2.3p) -> canonical (< p, 12-bit clean).
+    Boundary op: one sequential carry scan + three conditional subtracts
+    (input is offset by +4p to clear negativity first)."""
+    y = _carry_seq(a + jnp.asarray(_FOUR_P))  # value in (1.7p, 6.3p)
+    y = _cond_sub(y, _FOUR_P)
+    y = _cond_sub(y, _TWO_P)
+    return _cond_sub(y, P_LIMBS)
+
+
 @jax.jit
 def from_mont(a):
-    """Montgomery -> standard form (a * R^{-1} mod p) via reduction of a.
-    Canonical input limbs are already clean: no pre-carry needed."""
+    """Montgomery -> standard CANONICAL form (boundary op)."""
     t = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, LIMBS)])
-    return _mont_redc(t)
+    return canon(redc(t))
 
 
 def _exp_bits(e: int) -> np.ndarray:
@@ -324,7 +385,7 @@ def _exp_bits(e: int) -> np.ndarray:
 
 def pow_const(a, e: int):
     """a^e for a static exponent (square-and-always-multiply over the bit
-    array — branch-free, jit-stable). a in Montgomery form."""
+    array — branch-free, jit-stable). a in Montgomery form, relaxed."""
     if e == 0:
         return one_mont(a.shape[:-1])
     bits = jnp.asarray(_exp_bits(e))
@@ -346,8 +407,21 @@ def inv(a):
 
 
 def is_zero(a):
+    """Exact-zero limb test (infinity flags); NOT a value test — a relaxed
+    nonzero representation of 0 mod p returns False. Use `is_zero_mod`
+    for value semantics."""
     return jnp.all(a == 0, axis=-1)
 
 
+@jax.jit
+def is_zero_mod(a):
+    """value(a) == 0 (mod p) for any relaxed/wide input (< ~2^19 p).
+    One redc + one canon — boundary predicates only."""
+    t = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, LIMBS)])
+    return jnp.all(canon(redc(t)) == 0, axis=-1)
+
+
 def eq(a, b):
-    return jnp.all(a == b, axis=-1)
+    """Value equality of relaxed elements (canonicalizes both — boundary
+    op)."""
+    return jnp.all(canon(a) == canon(b), axis=-1)

@@ -41,17 +41,17 @@ def bits_msb(scalars, width: int) -> np.ndarray:
 def msm_g1(points_aff, bit_matrix):
     """sum_i scalar_i * P_i over G1.
 
-    points_aff: (x, y) mont-form (N, 32) arrays; bit_matrix: (N, nbits)
+    points_aff: (x, y) mont-form (N, 33) arrays; bit_matrix: (N, nbits)
     int32 MSB-first. Returns a Jacobian point (no batch dim).
     Scalar 0 rows contribute infinity (their running point stays Z=0).
     """
-    acc = cv.scalar_mul_var(cv.F1, points_aff, bit_matrix, fp.one_mont())
+    acc = cv.scalar_mul_var(cv.F1, points_aff, bit_matrix, fp.one_mont(), exact=True)
     return cv.fold_sum(cv.F1, acc)
 
 
 def msm_g2(points_aff, bit_matrix):
-    """sum_i scalar_i * Q_i over the G2 twist ((N, 2, 32) coords)."""
-    acc = cv.scalar_mul_var(cv.F2, points_aff, bit_matrix, tw.fp2_one())
+    """sum_i scalar_i * Q_i over the G2 twist ((N, 2, 33) coords)."""
+    acc = cv.scalar_mul_var(cv.F2, points_aff, bit_matrix, tw.fp2_one(), exact=True)
     return cv.fold_sum(cv.F2, acc)
 
 

@@ -21,6 +21,10 @@ TPU-first design decisions (vs the oracle's affine loop):
   array of |x|, with the (rare: 6 of 63) addition step under `lax.cond` —
   the graph contains each step once regardless of bit pattern, and the
   whole batch advances in lockstep.
+* **Lazy reduction** (round 5): the sparse line multiplication runs in the
+  accumulator domain of ops/fp.py — its 14 Fp2 products stay unreduced
+  through the Fp6/Fp12 combine and ONE stacked Montgomery reduction
+  materializes the 12 output coefficients (ops/tower.py docstring).
 * The final exponentiation mirrors the oracle's cubed-pairing HHT hard
   part op-for-op, so device and oracle outputs are **equal Fp12 elements**,
   not merely equivalent predicates. `f^|x|` is a scan with conditional
@@ -56,39 +60,43 @@ _X_BITS = np.array([int(b) for b in bin(BLS_X_ABS)[3:]], dtype=np.int32)
 
 
 def _mul_by_line(f, c0, c3, c5):
-    """f * (c0 + c3 w^3 + c5 w^5).
+    """f * (c0 + c3 w^3 + c5 w^5), entirely in the accumulator domain.
 
     Sparse multiplication exploiting the line's zero slots: with
     l0 = (c0,0,0) and l1 = (0,c3,c5) in the Fp6[w] halves,
-      t0    = a0*l0           (3 Fp2 muls: coefficient-wise scale by c0)
-      t1    = a1*l1           (sparse Fp6 mul, 6 Fp2 muls)
-      cross = (a0+a1)(l0+l1)  (dense-ish sparse, l0+l1 = (c0,c3,c5))
+      t0    = a0*l0           (coefficient-wise scale by c0)
+      t1    = a1*l1           (sparse Fp6 mul)
+      cross = (a0+a1)(l0+l1)  (dense Fp6 mul; l0+l1 = (c0,c3,c5))
+    The 8 non-dense Fp2 products ride ONE stacked fp2_mul_acc; `cross`
+    rides the stacked fp6_mul_acc; 12 reductions total.
     """
     a0, a1 = f[..., 0, :, :, :], f[..., 1, :, :, :]
-
-    # t0 = a0 * (c0, 0, 0): coefficient-wise scale (one broadcast fp2_mul)
-    t0 = tw.fp2_mul(a0, c0[..., None, :, :])
-
-    # t1 = a1 * (0, c3, c5): the five needed Fp2 products in one dispatch
     x0, x1, x2 = a1[..., 0, :, :], a1[..., 1, :, :], a1[..., 2, :, :]
-    m = tw.fp2_mul(
-        jnp.stack(
-            [x1, x2, tw.fp2_add(x1, x2), tw.fp2_add(x0, x1), tw.fp2_add(x0, x2)],
-            axis=-3,
-        ),
-        jnp.stack([c3, c5, tw.fp2_add(c3, c5), c3, c5], axis=-3),
+
+    # 8 Fp2 products in one dispatch: a0 coefficient-wise * c0 (3), and
+    # the 5 products of the sparse a1 * (0, c3, c5) Karatsuba
+    y0, y1, y2 = a0[..., 0, :, :], a0[..., 1, :, :], a0[..., 2, :, :]
+    lhs = jnp.stack(
+        [y0, y1, y2, x1, x2, tw.fp2_add(x1, x2), tw.fp2_add(x0, x1),
+         tw.fp2_add(x0, x2)],
+        axis=-3,
     )
-    p1, p2, m12, m01, m02 = (m[..., i, :, :] for i in range(5))
-    d0 = tw.fp2_mul_xi(tw.fp2_sub(tw.fp2_sub(m12, p1), p2))
-    d1 = tw.fp2_add(tw.fp2_sub(m01, p1), tw.fp2_mul_xi(p2))
-    d2 = tw.fp2_add(tw.fp2_sub(m02, p2), p1)
+    rhs = jnp.stack(
+        [c0, c0, c0, c3, c5, tw.fp2_add(c3, c5), c3, c5], axis=-3
+    )
+    m = tw.fp2_mul_acc(lhs, rhs)
+    t0 = m[..., 0:3, :, :]  # (.., 3, 2, 66) Fp6 accumulator
+    p1, p2, m12, m01, m02 = (m[..., 3 + i, :, :] for i in range(5))
+    d0 = tw._a2_mul_xi(fp.acc_sub(m12, fp.acc_add(p1, p2)))
+    d1 = fp.acc_add(fp.acc_sub(m01, p1), tw._a2_mul_xi(p2))
+    d2 = fp.acc_add(fp.acc_sub(m02, p2), p1)
     t1 = jnp.stack([d0, d1, d2], axis=-3)
 
     # cross = (a0 + a1) * (c0, c3, c5) dense
-    cross = tw.fp6_mul(tw.fp6_add(a0, a1), jnp.stack([c0, c3, c5], axis=-3))
-    r0 = tw.fp6_add(t0, tw.fp6_mul_by_v(t1))
-    r1 = tw.fp6_sub(tw.fp6_sub(cross, t0), t1)
-    return jnp.stack([r0, r1], axis=-4)
+    cross = tw.fp6_mul_acc(tw.fp6_add(a0, a1), jnp.stack([c0, c3, c5], axis=-3))
+    r0 = fp.acc_add(t0, tw._a6_mul_by_v(t1))
+    r1 = fp.acc_sub(cross, fp.acc_add(t0, t1))
+    return fp.redc(jnp.stack([r0, r1], axis=-4))
 
 
 def _fp2_triple(a):
@@ -99,8 +107,8 @@ def _fp2_triple(a):
 def miller_loop(p_aff, q_aff):
     """Batched f_{|x|,Q}(P), conjugated for the negative BLS parameter.
 
-    p_aff: (xp, yp) G1 affine, mont-form (.., 32) limb arrays.
-    q_aff: (xq, yq) twist affine over Fp2, (.., 2, 32) arrays.
+    p_aff: (xp, yp) G1 affine, mont-form (.., 33) limb arrays.
+    q_aff: (xq, yq) twist affine over Fp2, (.., 2, 33) arrays.
     Neither input may encode infinity (callers mask separately, as the
     oracle's `pairing` does for None inputs).
 
@@ -217,9 +225,9 @@ def pairing(p_aff, q_aff):
 def fp12_product_fold(f, mask=None):
     """Product of a batch of Fp12 values down axis 0 (tree fold).
 
-    f: (B, 2, 3, 2, 32). mask: optional (B,) bool — False entries are
+    f: (B, 2, 3, 2, 33). mask: optional (B,) bool — False entries are
     replaced with one (the device analogue of the oracle's skip-infinity
-    in `multi_pairing`). Returns (2, 3, 2, 32).
+    in `multi_pairing`). Returns (2, 3, 2, 33).
     """
     if mask is not None:
         ones = tw.fp12_one(f.shape[:1])

@@ -1,15 +1,27 @@
-"""Batched Fp2/Fp6/Fp12 tower arithmetic on TPU (JAX).
+"""Batched Fp2/Fp6/Fp12 tower arithmetic on TPU (JAX) — lazy-reduction form.
 
 1:1 vectorized counterpart of the CPU oracle
 `lodestar_tpu.crypto.bls.fields` (same tower construction, same Karatsuba
-shapes), over the limb field core in `lodestar_tpu.ops.fp`.
+shapes), over the relaxed limb core in `lodestar_tpu.ops.fp`.
+
+Round-5 redesign: every tower product is computed in the **accumulator
+domain** — base-field products stay as 66-limb accumulators, all Karatsuba
+combine steps (adds, subs, xi-multiplications) are elementwise accumulator
+ops, and ONE stacked Montgomery reduction materializes the final
+coefficients. An Fp12 multiply performs 12 reductions instead of 54, and
+zero sequential carry scans (the r1-r4 core canonicalized after every base
+op — the dispatch x HBM budget VERDICT r4 flagged). This is the classic
+lazy-reduction pairing schedule (Aranha et al.) reshaped for XLA: wide
+stacked dispatches, data-parallel carries only.
 
 Layouts (leading batch dims elided):
-  Fp2  = (2, 32)      c0 + c1*u
-  Fp6  = (3, 2, 32)   c0 + c1*v + c2*v^2
-  Fp12 = (2, 3, 2, 32) c0 + c1*w
+  Fp2  = (2, 33)       c0 + c1*u         acc: (2, 66)
+  Fp6  = (3, 2, 33)    c0 + c1*v + c2*v^2
+  Fp12 = (2, 3, 2, 33) c0 + c1*w
 
-All elements are in Montgomery form, canonical (< p) per limb vector.
+All elements are in Montgomery form (R = 2^396), relaxed (< ~2p, loose
+limbs) per ops/fp.py's contract; canonicalization happens only at the
+oracle bridges and predicates.
 """
 
 from __future__ import annotations
@@ -28,7 +40,9 @@ __all__ = [
     "fp2_neg",
     "fp2_conj",
     "fp2_mul",
+    "fp2_mul_acc",
     "fp2_sq",
+    "fp2_sq_acc",
     "fp2_mul_small",
     "fp2_mul_xi",
     "fp2_inv",
@@ -40,6 +54,7 @@ __all__ = [
     "fp6_sub",
     "fp6_neg",
     "fp6_mul",
+    "fp6_mul_acc",
     "fp6_sq",
     "fp6_mul_by_v",
     "fp6_inv",
@@ -59,7 +74,7 @@ __all__ = [
 
 
 def fp2_from_ints(vals) -> np.ndarray:
-    """[(c0, c1), ...] -> (N, 2, 32) mont-form limbs (host-side)."""
+    """[(c0, c1), ...] -> (N, 2, 33) mont-form limbs (host-side)."""
     out = np.stack(
         [np.stack([fp.limbs_from_int(c0), fp.limbs_from_int(c1)]) for c0, c1 in vals]
     )
@@ -100,33 +115,37 @@ def fp2_conj(a):
     return jnp.concatenate([a[..., 0:1, :], fp.neg(a[..., 1:2, :])], axis=-2)
 
 
-def fp2_mul(a, b):
-    """Karatsuba Fp2 product as ONE stacked mont_mul dispatch.
-
-    The three base-field products (t0, t1, cross) are independent, so they
-    are stacked along a fresh axis and computed by a single batched
-    `fp.mont_mul` — 3x fewer (and 3x larger) device ops per call, which is
-    both the TPU dispatch win and what keeps traced pairing graphs small.
-    """
+def fp2_mul_acc(a, b):
+    """Karatsuba Fp2 product in the accumulator domain: THREE base products
+    ride one stacked conv dispatch; the combine is elementwise acc ops; no
+    reduction happens here. Returns (.., 2, 66)."""
     a0, a1 = a[..., 0, :], a[..., 1, :]
     b0, b1 = b[..., 0, :], b[..., 1, :]
     lhs = jnp.stack([a0, a1, fp.add(a0, a1)], axis=-2)
     rhs = jnp.stack([b0, b1, fp.add(b0, b1)], axis=-2)
-    m = fp.mont_mul(lhs, rhs)
+    m = fp.mul_acc(lhs, rhs)
     t0, t1, cross = m[..., 0, :], m[..., 1, :], m[..., 2, :]
-    c0 = fp.sub(t0, t1)
-    c1 = fp.sub(fp.sub(cross, t0), t1)
+    c0 = fp.acc_sub(t0, t1)
+    c1 = fp.acc_sub(cross, fp.acc_add(t0, t1))
     return jnp.stack([c0, c1], axis=-2)
 
 
-def fp2_sq(a):
+def fp2_mul(a, b):
+    return fp.redc(fp2_mul_acc(a, b))
+
+
+def fp2_sq_acc(a):
+    """(a0+a1)(a0-a1) + 2 a0 a1 u — two base products, no reduction."""
     a0, a1 = a[..., 0, :], a[..., 1, :]
-    # (a0+a1)(a0-a1) + 2 a0 a1 u — both products in one dispatch
     lhs = jnp.stack([fp.add(a0, a1), a0], axis=-2)
     rhs = jnp.stack([fp.sub(a0, a1), a1], axis=-2)
-    m = fp.mont_mul(lhs, rhs)
-    c0, c1 = m[..., 0, :], m[..., 1, :]
-    return jnp.stack([c0, fp.add(c1, c1)], axis=-2)
+    m = fp.mul_acc(lhs, rhs)
+    c0, c1m = m[..., 0, :], m[..., 1, :]
+    return jnp.stack([c0, fp.acc_add(c1m, c1m)], axis=-2)
+
+
+def fp2_sq(a):
+    return fp.redc(fp2_sq_acc(a))
 
 
 def fp2_mul_small(a, k: int):
@@ -145,22 +164,29 @@ def fp2_mul_xi(a):
     return jnp.stack([fp.sub(a0, a1), fp.add(a0, a1)], axis=-2)
 
 
+def _a2_mul_xi(t):
+    """xi on an Fp2 accumulator pair (.., 2, 66)."""
+    t0, t1 = t[..., 0, :], t[..., 1, :]
+    return jnp.stack([fp.acc_sub(t0, t1), fp.acc_add(t0, t1)], axis=-2)
+
+
 def fp2_mul_fp(a, s):
-    """Multiply Fp2 element by an Fp scalar (mont form), shape (.., 32).
+    """Multiply Fp2 element by an Fp scalar (mont form), shape (.., 33).
 
     One broadcast mont_mul over the coefficient axis."""
     return fp.mont_mul(a, s[..., None, :])
 
 
 def fp2_inv(a):
-    sq = fp.mont_mul(a, a)  # a0^2, a1^2 in one dispatch
-    norm = fp.add(sq[..., 0, :], sq[..., 1, :])
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = fp.redc(fp.acc_add(fp.sq_acc(a0), fp.sq_acc(a1)))
     ninv = fp.inv(norm)
     scaled = fp.mont_mul(a, ninv[..., None, :])
     return jnp.stack([scaled[..., 0, :], fp.neg(scaled[..., 1, :])], axis=-2)
 
 
 def fp2_is_zero(a):
+    """Exact-zero limb test (see fp.is_zero semantics)."""
     return jnp.all(a == 0, axis=(-1, -2))
 
 
@@ -179,42 +205,41 @@ def fp6_neg(a):
     return fp.neg(a)
 
 
-def fp6_mul(a, b):
-    """Toom/Karatsuba Fp6 product: all 6 Fp2 products in ONE stacked
-    fp2_mul call (= one mont_mul dispatch of 18x the batch)."""
+def _a6_mul_by_v(t):
+    """v-shift on an Fp6 accumulator triple (.., 3, 2, 66)."""
+    return jnp.stack(
+        [_a2_mul_xi(t[..., 2, :, :]), t[..., 0, :, :], t[..., 1, :, :]], axis=-3
+    )
+
+
+def fp6_mul_acc(a, b):
+    """Toom/Karatsuba Fp6 product in the accumulator domain: all 6 Fp2
+    products (18 base convs) in ONE stacked fp2_mul_acc; combine is
+    elementwise acc ops. Returns (.., 3, 2, 66); no reduction."""
     a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
     b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
-    # pair sums (a1+a2, a0+a1, a0+a2) in one fp.add
-    sa = fp.add(
-        jnp.stack([a1, a0, a0], axis=-3), jnp.stack([a2, a1, a2], axis=-3)
-    )
-    sb = fp.add(
-        jnp.stack([b1, b0, b0], axis=-3), jnp.stack([b2, b1, b2], axis=-3)
-    )
+    sa = fp.add(jnp.stack([a1, a0, a0], axis=-3), jnp.stack([a2, a1, a2], axis=-3))
+    sb = fp.add(jnp.stack([b1, b0, b0], axis=-3), jnp.stack([b2, b1, b2], axis=-3))
     lhs = jnp.concatenate([jnp.stack([a0, a1, a2], axis=-3), sa], axis=-3)
     rhs = jnp.concatenate([jnp.stack([b0, b1, b2], axis=-3), sb], axis=-3)
-    m = fp2_mul(lhs, rhs)  # t0, t1, t2, m12, m01, m02
+    m = fp2_mul_acc(lhs, rhs)  # t0, t1, t2, m12, m01, m02 accs
     t0, t1, t2 = m[..., 0, :, :], m[..., 1, :, :], m[..., 2, :, :]
     m12, m01, m02 = m[..., 3, :, :], m[..., 4, :, :], m[..., 5, :, :]
-    # u_xy = m_xy - t_x - t_y, all three in one stacked sub pair
-    u = fp.sub(
-        fp.sub(
-            jnp.stack([m12, m01, m02], axis=-3),
-            jnp.stack([t1, t0, t0], axis=-3),
-        ),
-        jnp.stack([t2, t1, t2], axis=-3),
-    )
-    u12, u01, u02 = u[..., 0, :, :], u[..., 1, :, :], u[..., 2, :, :]
-    xi = fp2_mul_xi(jnp.stack([u12, t2], axis=-3))
-    c = fp.add(
-        jnp.stack([t0, u01, u02], axis=-3),
-        jnp.stack([xi[..., 0, :, :], xi[..., 1, :, :], t1], axis=-3),
-    )
-    return c
+    u12 = fp.acc_sub(m12, fp.acc_add(t1, t2))
+    u01 = fp.acc_sub(m01, fp.acc_add(t0, t1))
+    u02 = fp.acc_sub(m02, fp.acc_add(t0, t2))
+    c0 = fp.acc_add(t0, _a2_mul_xi(u12))
+    c1 = fp.acc_add(u01, _a2_mul_xi(t2))
+    c2 = fp.acc_add(u02, t1)
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fp6_mul(a, b):
+    return fp.redc(fp6_mul_acc(a, b))
 
 
 def fp6_sq(a):
-    return fp6_mul(a, a)
+    return fp.redc(fp6_mul_acc(a, a))
 
 
 def fp6_mul_by_v(a):
@@ -225,28 +250,28 @@ def fp6_mul_by_v(a):
 
 def fp6_inv(a):
     a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
-    # six products (a0^2, a1*a2, xi path ...) in one stacked fp2_mul
-    m = fp2_mul(
+    # six products (a0^2, a1*a2, ...) in one stacked fp2 acc mul
+    m = fp2_mul_acc(
         jnp.stack([a0, a1, a2, a0, a1, a0], axis=-3),
         jnp.stack([a0, a2, a2, a1, a1, a2], axis=-3),
     )
     sq0, m12, sq2, m01, sq1, m02 = (m[..., i, :, :] for i in range(6))
-    xi = fp2_mul_xi(jnp.stack([m12, sq2], axis=-3))
-    c0 = fp2_sub(sq0, xi[..., 0, :, :])
-    c1 = fp2_sub(xi[..., 1, :, :], m01)
-    c2 = fp2_sub(sq1, m02)
-    # t = a0 c0 + xi (a2 c1 + a1 c2): three products in one dispatch
-    tm = fp2_mul(
+    c0 = fp.redc(fp.acc_sub(sq0, _a2_mul_xi(m12)[..., :, :]))
+    xi_sq2 = _a2_mul_xi(sq2)
+    c1 = fp.redc(fp.acc_sub(xi_sq2, m01))
+    c2 = fp.redc(fp.acc_sub(sq1, m02))
+    # t = a0 c0 + xi (a2 c1 + a1 c2): three products, combine in acc
+    tm = fp2_mul_acc(
         jnp.stack([a0, a2, a1], axis=-3), jnp.stack([c0, c1, c2], axis=-3)
     )
-    t = fp2_add(
-        tm[..., 0, :, :],
-        fp2_mul_xi(fp2_add(tm[..., 1, :, :], tm[..., 2, :, :])),
+    t = fp.redc(
+        fp.acc_add(
+            tm[..., 0, :, :],
+            _a2_mul_xi(fp.acc_add(tm[..., 1, :, :], tm[..., 2, :, :])),
+        )
     )
     tinv = fp2_inv(t)
-    return fp2_mul(
-        jnp.stack([c0, c1, c2], axis=-3), tinv[..., None, :, :]
-    )
+    return fp2_mul(jnp.stack([c0, c1, c2], axis=-3), tinv[..., None, :, :])
 
 
 # --- Fp12 = Fp6[w]/(w^2 - v) ------------------------------------------------
@@ -258,21 +283,33 @@ def fp12_one(batch_shape=()):
 
 
 def fp12_mul(a, b):
-    """Karatsuba Fp12 product: all 54 base-field products ride ONE
-    mont_mul dispatch (3 stacked fp6_mul -> 18 stacked fp2_mul -> 54)."""
+    """Karatsuba Fp12 product: all 54 base-field products ride ONE conv
+    dispatch chain (3 stacked fp6_mul_acc -> 18 fp2 -> 54 convs), the
+    combine is elementwise acc ops, and ONE stacked reduction materializes
+    the 12 coefficients."""
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
     lhs = jnp.stack([a0, a1, fp6_add(a0, a1)], axis=-4)
     rhs = jnp.stack([b0, b1, fp6_add(b0, b1)], axis=-4)
-    m = fp6_mul(lhs, rhs)
+    m = fp6_mul_acc(lhs, rhs)
     t0, t1, cross = m[..., 0, :, :, :], m[..., 1, :, :, :], m[..., 2, :, :, :]
-    c0 = fp6_add(t0, fp6_mul_by_v(t1))
-    c1 = fp6_sub(fp6_sub(cross, t0), t1)
-    return jnp.stack([c0, c1], axis=-4)
+    c0 = fp.acc_add(t0, _a6_mul_by_v(t1))
+    c1 = fp.acc_sub(cross, fp.acc_add(t0, t1))
+    return fp.redc(jnp.stack([c0, c1], axis=-4))
 
 
 def fp12_sq(a):
-    return fp12_mul(a, a)
+    """Karatsuba square: (a0 + a1 w)^2 needs only TWO Fp6 products
+    (t = a0*a1 and s = (a0+a1)(a0 + v*a1)): c0 = s - t - v*t, c1 = 2t.
+    36 base convs + 12 reductions (vs 54 + 54 in the r4 core)."""
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    lhs = jnp.stack([a0, fp6_add(a0, a1)], axis=-4)
+    rhs = jnp.stack([a1, fp6_add(a0, fp6_mul_by_v(a1))], axis=-4)
+    m = fp6_mul_acc(lhs, rhs)
+    t, s = m[..., 0, :, :, :], m[..., 1, :, :, :]
+    c0 = fp.acc_sub(s, fp.acc_add(t, _a6_mul_by_v(t)))
+    c1 = fp.acc_add(t, t)
+    return fp.redc(jnp.stack([c0, c1], axis=-4))
 
 
 def fp12_conj(a):
@@ -282,8 +319,10 @@ def fp12_conj(a):
 def fp12_inv(a):
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     both = jnp.stack([a0, a1], axis=-4)
-    sq = fp6_mul(both, both)  # a0^2, a1^2 in one dispatch
-    t = fp6_sub(sq[..., 0, :, :, :], fp6_mul_by_v(sq[..., 1, :, :, :]))
+    sq = fp6_mul_acc(both, both)  # a0^2, a1^2 accs in one dispatch
+    t = fp.redc(
+        fp.acc_sub(sq[..., 0, :, :, :], _a6_mul_by_v(sq[..., 1, :, :, :]))
+    )
     tinv = fp6_inv(t)
     scaled = fp6_mul(both, tinv[..., None, :, :, :])
     return jnp.stack(
@@ -292,22 +331,20 @@ def fp12_inv(a):
 
 
 def fp12_eq_one(a):
-    """Batch predicate a == 1 (mont form)."""
+    """Batch predicate a == 1 (mont form). Canonicalizes (boundary op)."""
     one = fp12_one(a.shape[:-4])
-    return jnp.all(a == one, axis=(-1, -2, -3, -4))
+    return jnp.all(fp.canon(a) == one, axis=(-1, -2, -3, -4))
 
 
 # Frobenius coefficients g_i(k) = xi^(i*(p^k-1)/6) for powers k=1..3,
 # derived through the oracle. Computed in PURE PYTHON via
 # fp.mont_limbs_from_int — no JAX at import time, so importing this
-# module never initializes a device backend (the r3 multichip dryrun
-# failed precisely because fp2_from_ints -> fp.to_mont ran jitted JAX
-# here and woke the default TPU backend before the dryrun picked its CPU
-# mesh).
+# module never initializes a device backend (the r3 multichip-gate
+# regression class).
 
 
 def _fp2_mont_limbs_host(c0: int, c1: int) -> np.ndarray:
-    """(c0, c1) ints -> (2, 32) mont-form limbs, numpy only."""
+    """(c0, c1) ints -> (2, 33) mont-form limbs, numpy only."""
     return np.stack([fp.mont_limbs_from_int(c0), fp.mont_limbs_from_int(c1)])
 
 
@@ -344,7 +381,7 @@ def fp12_frobenius(a, power: int = 1):
     coefficient products in one stacked fp2_mul)."""
     if power not in (1, 2, 3):
         raise ValueError("frobenius power must be 1..3")
-    stacked = jnp.stack(_to_w_coeffs(a), axis=-3)  # (.., 6, 2, 32)
+    stacked = jnp.stack(_to_w_coeffs(a), axis=-3)  # (.., 6, 2, 33)
     if power % 2 == 1:
         stacked = fp2_conj(stacked)
     prod = fp2_mul(stacked, jnp.asarray(_FROB_K[power]))
@@ -355,7 +392,7 @@ def fp12_frobenius(a, power: int = 1):
 
 
 def fp12_from_oracle(vals) -> np.ndarray:
-    """List of oracle Fp12 tuples -> (N, 2, 3, 2, 32) mont limbs."""
+    """List of oracle Fp12 tuples -> (N, 2, 3, 2, 33) mont limbs."""
     flat = []
     for v in vals:
         for half in v:

@@ -58,7 +58,11 @@ class TestG1:
         # zero out the infinity slot's Z
         Z = np.asarray(Z).copy()
         Z[3] = 0
-        got = g1_from_jac_dev(cv.jac_add_mixed(cv.F1, (X, Y, Z), g1_to_dev(b_pts), ONE1))
+        # P==Q / P==-Q completeness is the exact=True contract (the fast
+        # default is reserved for flows where collisions are unreachable)
+        got = g1_from_jac_dev(
+            cv.jac_add_mixed(cv.F1, (X, Y, Z), g1_to_dev(b_pts), ONE1, exact=True)
+        )
         expect = [
             C.g1_add(C.g1_mul(G1_GEN, a) if a is not None else None, b)
             for a, b in zip(a_ks, b_pts)
@@ -116,7 +120,9 @@ class TestG2:
         a, b = g2_pts([5, 7]), g2_pts([9, 7])
         one2 = np.zeros((2, fp.LIMBS), dtype=np.int32)
         one2[0] = np.asarray(ONE1)
-        got = g2_from_jac_dev(cv.jac_add_mixed(cv.F2, jac2(a), g2_to_dev(b), one2))
+        got = g2_from_jac_dev(
+            cv.jac_add_mixed(cv.F2, jac2(a), g2_to_dev(b), one2, exact=True)
+        )
         assert got == [C.g2_add(x, y) for x, y in zip(a, b)]
 
     def test_scalar_mul_var_matches_oracle(self):

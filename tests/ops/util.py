@@ -35,10 +35,11 @@ def fp_from_dev(arr):
 
 
 def assert_clean(arr):
-    """All limbs 12-bit clean (the canonical-representation contract)."""
+    """Limbs within the relaxed signed contract of the r5 field core
+    (ops/fp.py docstring): |limb| <= ~2^12 + 70."""
     a = np.asarray(arr)
-    assert a.min() >= 0 and a.max() <= fp.LIMB_MASK, (
-        f"limbs not 12-bit clean: min={a.min()} max={a.max()}"
+    assert a.min() >= -fp.LIMB_LOOSE and a.max() <= fp.LIMB_LOOSE, (
+        f"limbs out of relaxed range: min={a.min()} max={a.max()}"
     )
 
 

@@ -1,9 +1,10 @@
-"""Microbenchmark fp-kernel variants on the real device.
+"""Microbenchmark fp-kernel primitives on the real device (r5 core).
 
 Measures, at the batch-verify operating shape (~221k field elements),
-chained invocations of each variant (k per launch, so per-call cost is
-dispatch-amortized), syncing on a scalar device->host transfer — 
-block_until_ready does NOT reliably wait through the axon relay.
+chained invocations of each primitive (k per launch, so per-call cost is
+dispatch-amortized), syncing on a scalar device->host transfer —
+block_until_ready does NOT reliably wait through the axon relay. Chains
+feed outputs back into inputs (CSE-proof; the r4 lesson).
 
 Run: python tools/kernel_microbench.py [batch] [chain]
 """
@@ -19,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from lodestar_tpu.ops import fp
+from lodestar_tpu.ops import tower as tw
 from lodestar_tpu.utils import enable_compile_cache
 
 enable_compile_cache(".")
@@ -37,7 +39,7 @@ def rand_fp(n):
 a = rand_fp(B)
 b = rand_fp(B)
 
-ARR = B * 32 * 4  # one (B, 32) int32 pass
+ARR = B * fp.LIMBS * 4  # one (B, 33) int32 pass
 
 
 def chained(op):
@@ -50,70 +52,38 @@ def chained(op):
     return f
 
 
-def timeit(name, op, iters=3, passes_per_call=3):
+def timeit(name, op, iters=3, passes_per_call=3, x=None, y=None):
     f = chained(op)
-    np.asarray(f(a, b))  # compile + warm
+    x = a if x is None else x
+    y = b if y is None else y
+    np.asarray(f(x, y))  # compile + warm
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = np.asarray(f(a, b))
+        out = np.asarray(f(x, y))
     dt = (time.perf_counter() - t0) / iters / K
     gbps = passes_per_call * ARR / dt / 1e9
     print(f"{name:34s} {dt*1e3:9.3f} ms/call  {gbps:7.1f} GB/s(min)", flush=True)
     return dt
 
 
-timeit("mont_mul (live)", fp.mont_mul)
-timeit("mont_sq (live)", lambda x, y: fp.mont_sq(x))
-timeit("add (live)", fp.add)
-timeit("_carry_seq", lambda x, y: fp._carry_seq(x + y), passes_per_call=2)
-timeit("_cond_sub_p", lambda x, y: fp._cond_sub_p(jnp.clip(x + y, 0, 4095)), passes_per_call=2)
-timeit("_carry3(64)", lambda x, y: fp._carry3(jnp.concatenate([x, y], -1))[..., :32], passes_per_call=4)
+timeit("mont_mul (relaxed)", fp.mont_mul)
+timeit("mont_sq (relaxed)", lambda x, y: fp.mont_sq(x))
+timeit("add", fp.add)
+timeit("sub", fp.sub)
+timeit("mul_acc + redc", lambda x, y: fp.redc(fp.mul_acc(x, y)))
+timeit(
+    "2 acc sum + 1 redc",
+    lambda x, y: fp.redc(fp.acc_add(fp.mul_acc(x, y), fp.sq_acc(x))),
+)
 
-_T = np.zeros((fp.LIMBS * fp.LIMBS, 2 * fp.LIMBS), dtype=np.int32)
-for i in range(fp.LIMBS):
-    for j in range(fp.LIMBS):
-        _T[i * fp.LIMBS + j, i + j] = 1
-
-
-def conv_band(x, y):
-    outer = x[..., :, None] * y[..., None, :]
-    flat = outer.reshape(*outer.shape[:-2], fp.LIMBS * fp.LIMBS)
-    return (flat @ jnp.asarray(_T))[..., :32]
-
-
-def conv_shift(x, y):
-    # true 32-term shifted-FMA formulation (fp._conv_pair is now the band
-    # matmul; this keeps the alternative measurable)
-    total = None
-    for j in range(32):
-        term = jnp.pad(x * y[:, j : j + 1], [(0, 0), (j, 32 - j)])
-        total = term if total is None else total + term
-    return total[..., :32]
-
-
-def conv_stacksum(x, y):
-    terms = [
-        jnp.pad(x * y[..., j : j + 1], [(0, 0), (j, fp.LIMBS - j)])
-        for j in range(fp.LIMBS)
-    ]
-    return jnp.sum(jnp.stack(terms, 0), 0)[..., :32]
-
-
-timeit("conv shifted-FMA (live)", conv_shift, passes_per_call=4)
-timeit("conv outer+band matmul (old)", conv_band, passes_per_call=4)
-timeit("conv stack+sum", conv_stacksum, passes_per_call=4)
-
-
-def mont_mul_lazy(x, y):
-    t = fp._carry_once(fp._carry_once(fp._conv_pair(x, y)))
-    m = fp._carry_once(fp._carry_once(fp._conv_pprime_low(t[..., : fp.LIMBS])))
-    s = fp._carry_once(fp._carry_once(t + fp._conv_p_full(m)))
-    carry = jnp.any(s[..., : fp.LIMBS] != 0, axis=-1)
-    hi = s[..., fp.LIMBS :]
-    hi0 = hi[..., :1] + carry[..., None].astype(jnp.int32)
-    return jnp.concatenate([hi0, hi[..., 1:]], axis=-1)
-
-
-timeit("mont_mul LAZY (no scans)", mont_mul_lazy)
+# tower shapes: fp2 at B/2, fp12 at B/12 keeps total element count ~B
+a2 = a[: (B // 2) * 2].reshape(B // 2, 2, fp.LIMBS)
+b2 = b[: (B // 2) * 2].reshape(B // 2, 2, fp.LIMBS)
+timeit("fp2_mul (acc domain)", tw.fp2_mul, x=a2, y=b2)
+n12 = B // 12
+a12 = a[: n12 * 12].reshape(n12, 2, 3, 2, fp.LIMBS)
+b12 = b[: n12 * 12].reshape(n12, 2, 3, 2, fp.LIMBS)
+timeit("fp12_mul (12 redc)", tw.fp12_mul, x=a12, y=b12)
+timeit("fp12_sq (karatsuba)", lambda x, y: tw.fp12_sq(x), x=a12, y=b12)
 
 print("done", flush=True)
