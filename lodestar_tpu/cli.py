@@ -30,6 +30,7 @@ def _build_parser() -> argparse.ArgumentParser:
     dev.add_argument("--p2p-port", type=int, default=0, help="serve P2P (TCP/noise/gossipsub) on this port")
     dev.add_argument("--genesis-time", type=int, default=0, help="interop genesis_time (share with peers)")
     dev.add_argument("--linger", type=float, default=0.0, help="keep serving P2P this many seconds after the last slot")
+    dev.add_argument("--altair-epoch", type=int, default=None, help="enable the altair fork at this epoch (default: never)")
 
     beacon = sub.add_parser("beacon", help="run a beacon node")
     beacon.add_argument("--db", default=None, help="data directory (default: in-memory)")
@@ -43,6 +44,7 @@ def _build_parser() -> argparse.ArgumentParser:
     beacon.add_argument("--genesis-time", type=int, default=0, help="interop genesis_time (share with peers)")
     beacon.add_argument("--sync-target", type=int, default=0, help="exit 0 once head reaches this slot (testing)")
     beacon.add_argument("--slot-time", type=int, default=0, help="dev-genesis slot seconds (match the dev node)")
+    beacon.add_argument("--altair-epoch", type=int, default=None, help="dev-genesis: altair fork epoch (match the dev node)")
     beacon.add_argument(
         "--checkpoint-sync-url",
         default=None,
@@ -78,7 +80,8 @@ async def _run_dev(args) -> int:
     p = params.active_preset()
     far = 2**64 - 1
     cc = minimal_chain_config().replace(
-        ALTAIR_FORK_EPOCH=far, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far
+        ALTAIR_FORK_EPOCH=far if args.altair_epoch is None else args.altair_epoch,
+        BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far, DENEB_FORK_EPOCH=far,
     )
     p2p = args.p2p_port != 0
     if p2p:
@@ -165,7 +168,7 @@ async def _run_beacon(args) -> int:
     if args.dev_genesis:
         far = 2**64 - 1
         chain_cfg = chain_cfg.replace(
-            ALTAIR_FORK_EPOCH=far,
+            ALTAIR_FORK_EPOCH=far if args.altair_epoch is None else args.altair_epoch,
             BELLATRIX_FORK_EPOCH=far,
             CAPELLA_FORK_EPOCH=far,
             DENEB_FORK_EPOCH=far,
@@ -275,8 +278,10 @@ async def _sync_and_follow(node, args) -> int | None:
     from lodestar_tpu.sync.range_sync import RangeSync
 
     net = node.network
-    # wait for a peer
-    for _ in range(100):
+    # wait for a peer: generous window — the remote may be inside a
+    # first-use jit compile (STF) with its event loop pinned, and the
+    # bootnode redial loop lands a connection once it resurfaces
+    for _ in range(450):
         if net.host.peers():
             break
         await asyncio.sleep(0.2)

@@ -26,17 +26,43 @@ class ReqRespBeaconNode(ReqResp):
         super().__init__(**kw)
         self.chain = chain
         self._seq = metadata_seq
+        # self-configure the ForkDigest context from the chain so every
+        # embedding (network service, direct tests) can serve V2/LC chunks
+        from lodestar_tpu.config import FORK_ORDER, create_beacon_config
+
+        try:
+            gvr = bytes(chain.get_head_state().genesis_validators_root)
+            bc = create_beacon_config(chain.cfg, gvr)
+            digest_to_fork = {bc.fork_digest(f): f for f in FORK_ORDER}
+            self.set_fork_context(bc.fork_digest, digest_to_fork.get)
+        except Exception:
+            # dev/test chains without a chain config: serve zero-digest
+            # context; digest_to_fork stays None so a client half falls
+            # back to static chunk types instead of raising unknown-digest
+            self.set_fork_context(lambda f: b"\x00\x00\x00\x00", None)
         self.register_handler(_pid("status"), self._on_status)
         self.register_handler(_pid("ping"), self._on_ping)
         self.register_handler(_pid("metadata"), self._on_metadata)
         self.register_handler(
             _pid("beacon_blocks_by_range"),
-            self._on_blocks_by_range,
+            self._on_blocks_by_range_v1,
             quota=RateLimiterQuota(500, 10.0),
         )
         self.register_handler(
             _pid("beacon_blocks_by_root"),
-            self._on_blocks_by_root,
+            self._on_blocks_by_root_v1,
+            quota=RateLimiterQuota(128, 10.0),
+        )
+        # V2: ForkDigest-context chunks, fork-resolved types (reference
+        # ReqRespBeaconNode BeaconBlocksByRangeV2/RootV2)
+        self.register_handler(
+            _pid("beacon_blocks_by_range", 2),
+            self._on_blocks_by_range_v2,
+            quota=RateLimiterQuota(500, 10.0),
+        )
+        self.register_handler(
+            _pid("beacon_blocks_by_root", 2),
+            self._on_blocks_by_root_v2,
             quota=RateLimiterQuota(128, 10.0),
         )
         self.register_handler(_pid("goodbye"), self._on_goodbye)
@@ -84,6 +110,38 @@ class ReqRespBeaconNode(ReqResp):
         md = t.phase0.Metadata.default()
         md.seq_number = self._seq
         yield md
+
+    def _block_fork(self, signed) -> str:
+        return self.chain.fork_name_at_slot(int(signed.message.slot))
+
+    def _lc_fork(self, slot: int) -> str:
+        """Fork digest fork for light-client chunks: LC containers exist
+        from altair on, so phase0-era headers ride the altair digest."""
+        fork = self.chain.fork_name_at_slot(int(slot))
+        return "altair" if fork == "phase0" else fork
+
+    async def _on_blocks_by_range_v1(self, req, peer):
+        """V1: context-free, phase0-typed chunks only. The stream ends at
+        the first post-phase0 block (its SSZ layout cannot ride V1) —
+        matching the reference's V1-for-phase0-history semantics."""
+        async for signed in self._on_blocks_by_range(req, peer):
+            if self._block_fork(signed) != "phase0":
+                return
+            yield signed
+
+    async def _on_blocks_by_root_v1(self, req, peer):
+        async for signed in self._on_blocks_by_root(req, peer):
+            if self._block_fork(signed) != "phase0":
+                continue
+            yield signed
+
+    async def _on_blocks_by_range_v2(self, req, peer):
+        async for signed in self._on_blocks_by_range(req, peer):
+            yield self._block_fork(signed), signed
+
+    async def _on_blocks_by_root_v2(self, req, peer):
+        async for signed in self._on_blocks_by_root(req, peer):
+            yield self._block_fork(signed), signed
 
     async def _on_blocks_by_range(self, req, peer):
         if req.count == 0 or req.step != 1:
@@ -146,7 +204,7 @@ class ReqRespBeaconNode(ReqResp):
                 root = ns.BeaconBlock.hash_tree_root(signed.message)
             sidecar = self.chain.get_blobs_sidecar(root)
             if sidecar is not None:
-                yield sidecar
+                yield "deneb", sidecar
 
     # -- light-client protocols ------------------------------------------------
 
@@ -165,7 +223,7 @@ class ReqRespBeaconNode(ReqResp):
             raise ReqRespError(f"unknown bootstrap checkpoint root: {e}") from e
         if bootstrap is None:
             raise ReqRespError("unknown bootstrap checkpoint root")
-        yield bootstrap
+        yield self._lc_fork(int(bootstrap.header.beacon.slot)), bootstrap
 
     async def _on_lc_updates_by_range(self, req, peer):
         # clamp the peer-supplied u64 BEFORE get_updates materializes a
@@ -177,16 +235,16 @@ class ReqRespBeaconNode(ReqResp):
         cap = protocol_by_id(_pid("light_client_updates_by_range")).max_response_chunks
         count = min(int(req.count), cap)
         for update in self._lc().get_updates(int(req.start_period), count):
-            yield update
+            yield self._lc_fork(int(update.attested_header.beacon.slot)), update
 
     async def _on_lc_finality(self, req, peer):
         update = self._lc().get_finality_update()
         if update is None:
             raise ReqRespError("no finality update available")
-        yield update
+        yield self._lc_fork(int(update.attested_header.beacon.slot)), update
 
     async def _on_lc_optimistic(self, req, peer):
         update = self._lc().get_optimistic_update()
         if update is None:
             raise ReqRespError("no optimistic update available")
-        yield update
+        yield self._lc_fork(int(update.attested_header.beacon.slot)), update

@@ -107,6 +107,7 @@ class Libp2pBeaconNetwork:
         self._discv5_bootnodes = list(discv5_bootnodes or [])
         self.target_peers = target_peers
         self._discovery_task = None
+        self._bootnode_task = None
         self.gossip.set_validator(self._validate_gossip)
         self.host.on_peer_connect = self._on_peer_connect
         self.host.on_peer_disconnect = self._on_peer_disconnect
@@ -124,6 +125,9 @@ class Libp2pBeaconNetwork:
         self.beacon_cfg = create_beacon_config(self.chain.cfg, gvr)
         for fork in FORK_ORDER:
             self._digest_to_fork[self.beacon_cfg.fork_digest(fork)] = fork
+        self.reqresp.set_fork_context(
+            self.beacon_cfg.fork_digest, self._digest_to_fork.get
+        )
         port = await self.host.listen(host_addr)
         self.gossip.start()
         await self._subscribe_core_topics()
@@ -131,7 +135,12 @@ class Libp2pBeaconNetwork:
             try:
                 await self.host.connect(bhost, bport)
             except Exception as e:
-                self.log.warn(f"bootnode {bhost}:{bport} dial failed: {e}")
+                self.log.warn(f"bootnode {bhost}:{bport} dial failed: {e!r}")
+        if self.bootnodes:
+            # keep re-dialing static bootnodes while under-peered: a single
+            # boot-time attempt loses the peer forever if the remote's event
+            # loop was momentarily wedged (e.g. first jit compile of the STF)
+            self._bootnode_task = asyncio.ensure_future(self._bootnode_redial_loop())
 
         # discv5 DHT: advertise our tcp endpoint + fork digest, discover
         # peers' tcp endpoints and keep dialing toward the target
@@ -150,6 +159,26 @@ class Libp2pBeaconNetwork:
 
         self.log.info(f"p2p listening on {host_addr}:{port} as {self.host.peer_id}")
         return port
+
+    async def _bootnode_redial_loop(self, interval: float = 5.0) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                if len(self.host.peers()) >= max(1, min(self.target_peers, len(self.bootnodes))):
+                    continue
+                live = {pc.addr for pc in self.host.connections.values()}
+                for (bhost, bport) in self.bootnodes:
+                    if (bhost, bport) in live:
+                        continue  # re-dialing would tear down the live conn
+                    try:
+                        await self.host.connect(bhost, bport)
+                        self.log.info(f"bootnode {bhost}:{bport} connected on retry")
+                    except Exception as e:
+                        self.log.debug(f"bootnode {bhost}:{bport} redial failed: {e!r}")
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.log.debug(f"bootnode redial loop error: {e!r}")
 
     async def _discovery_loop(self, interval: float = 5.0) -> None:
         """Bootstrap the DHT while under-peered, then dial discovered
@@ -206,6 +235,9 @@ class Libp2pBeaconNetwork:
         if self._discovery_task is not None:
             self._discovery_task.cancel()
             self._discovery_task = None
+        if self._bootnode_task is not None:
+            self._bootnode_task.cancel()
+            self._bootnode_task = None
         if self.discv5 is not None:
             await self.discv5.stop()
             self.discv5 = None
@@ -283,14 +315,36 @@ class Libp2pBeaconNetwork:
     async def _serve_stream(self, stream, peer_id: str) -> None:
         await self.reqresp.handle_stream(stream, stream, peer_id=peer_id)
 
-    async def _request(self, peer_id: str, name: str, request, max_chunks=None):
-        pid = f"/eth2/beacon_chain/req/{name}/1/ssz_snappy"
+    async def _request(
+        self, peer_id: str, name: str, request, max_chunks=None, version: int = 1
+    ):
+        pid = f"/eth2/beacon_chain/req/{name}/{version}/ssz_snappy"
 
         async def dial():
             s = await self.host.new_stream(peer_id, pid)
             return s, s
 
         return await self.reqresp.send_request(dial, pid, request, max_chunks=max_chunks)
+
+    async def _request_versioned(
+        self, peer_id: str, name: str, request, max_chunks=None, versions=(2, 1)
+    ):
+        """Dial the newest protocol version first, fall back ONLY on a
+        multistream 'na' (the peer does not speak that version — reference
+        dials V2 with V1 fallback for block protocols). Transport faults
+        and response errors propagate: falling back on them would let a
+        mid-stream failure masquerade as a short valid response."""
+        from lodestar_tpu.network.transport.multistream import NegotiationError
+
+        last = None
+        for v in versions:
+            try:
+                return await self._request(
+                    peer_id, name, request, max_chunks=max_chunks, version=v
+                )
+            except NegotiationError as e:
+                last = e
+        raise last
 
     async def status(self, peer_id: str):
         out = await self._request(peer_id, "status", self.reqresp.local_status())
@@ -302,7 +356,7 @@ class Libp2pBeaconNetwork:
         req.start_slot = start_slot
         req.count = count
         req.step = 1
-        return await self._request(peer_id, "beacon_blocks_by_range", req)
+        return await self._request_versioned(peer_id, "beacon_blocks_by_range", req)
 
     async def blobs_by_range(self, peer_id: str, start_slot: int, count: int):
         t = ssz_types(self.chain.p)
@@ -313,7 +367,7 @@ class Libp2pBeaconNetwork:
 
     async def blocks_by_root(self, peer_id: str, roots: list[bytes]):
         # request type is List[Bytes32]; the engine serializes the raw list
-        return await self._request(peer_id, "beacon_blocks_by_root", list(roots))
+        return await self._request_versioned(peer_id, "beacon_blocks_by_root", list(roots))
 
     # -- gossip egress ---------------------------------------------------------
 

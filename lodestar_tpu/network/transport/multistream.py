@@ -47,6 +47,10 @@ def decode_ms(buf: bytes, pos: int = 0) -> tuple[str, int]:
     return line.rstrip(b"\n").decode(), pos + ln
 
 
+class NegotiationError(ConnectionError):
+    """The remote refused the proposed protocol (multistream 'na')."""
+
+
 async def negotiate_out(send, recv, protocol: str) -> bytes:
     """Dialer side over a frame channel: propose `protocol`, expect echo.
 
@@ -66,7 +70,7 @@ async def negotiate_out(send, recv, protocol: str) -> bytes:
         except IndexError:
             continue
     if seen[0] != MS_PROTO or seen[1] != protocol:
-        raise ConnectionError(f"multistream negotiation failed: {seen}")
+        raise NegotiationError(f"multistream negotiation failed: {seen}")
     return buf
 
 

@@ -99,21 +99,33 @@ async def read_request(reader: asyncio.StreamReader, max_len: int = 2**22) -> by
 
 
 async def write_response_chunk(
-    writer: asyncio.StreamWriter, status: int, ssz_bytes: bytes
+    writer: asyncio.StreamWriter, status: int, ssz_bytes: bytes,
+    context: bytes = b"",
 ) -> None:
-    writer.write(bytes([status]) + _encode_varint(len(ssz_bytes)) + frame_compress(ssz_bytes))
+    """One response chunk. `context` (e.g. a 4-byte fork digest) rides
+    between the result byte and the length varint on SUCCESS chunks —
+    reference encodingStrategies ContextBytes placement."""
+    head = bytes([status]) + (context if status == 0 else b"")
+    writer.write(head + _encode_varint(len(ssz_bytes)) + frame_compress(ssz_bytes))
     await writer.drain()
 
 
-async def read_response_chunks(reader: asyncio.StreamReader, max_len: int = 2**22):
-    """Async iterator of (status, payload) until EOF."""
+async def read_response_chunks(
+    reader: asyncio.StreamReader, max_len: int = 2**22, context_len: int = 0
+):
+    """Async iterator of (status, context, payload) until EOF.
+    `context_len` bytes are read after SUCCESS result bytes only
+    (error chunks carry a bare message)."""
     while True:
         try:
             status_b = await reader.readexactly(1)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             return
+        context = b""
+        if status_b[0] == 0 and context_len:
+            context = await reader.readexactly(context_len)
         n = await _read_varint(reader)
         if n > max_len:
             raise EncodingError(f"response chunk too large: {n}")
         payload = await _read_snappy_frames(reader, n)
-        yield status_b[0], payload
+        yield status_b[0], context, payload

