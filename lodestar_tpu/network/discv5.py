@@ -18,12 +18,11 @@ Implements the protocol's real mechanics natively on asyncio UDP:
   buckets), a flat routing table with distance queries, and a
   bootstrap/refresh loop.
 
-Scope note: one deliberate deviation from wire-level interop with
-other implementations — the ECDH secret uses the x-coordinate (what
-`cryptography` exposes) rather than the compressed shared point, so
-sessions interoperate between lodestar-tpu nodes but not with e.g.
-sigp/discv5 peers. Everything else (packet layout, masking, key
-schedule shape, ENR format) follows the spec.
+The ECDH secret is the spec's COMPRESSED SHARED POINT (33 bytes,
+parity prefix + x) — `cryptography` only exposes the x-coordinate, so
+`_ecdh_compressed` runs the secp256k1 scalar multiplication itself to
+recover the y parity; the key schedule passes the discv5 v5.1 spec
+test vectors byte-exact (tests/network/test_discv5.py).
 """
 
 from __future__ import annotations
@@ -54,6 +53,71 @@ from lodestar_tpu.logger import get_logger
 from lodestar_tpu.prover.mpt import keccak256, rlp_decode, rlp_encode
 
 __all__ = ["Enr", "Discv5Node", "log2_distance"]
+
+# secp256k1 parameters for the compressed-point ECDH (the spec secret is
+# the 33-byte compressed shared point; the `cryptography` ECDH API yields
+# only x, losing the parity byte)
+_SECP_P = 2**256 - 2**32 - 977
+_SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _ecdh_compressed(private_key, public_key) -> bytes:
+    """discv5 v5.1 ECDH: compressed point of k*P on secp256k1.
+
+    The double-and-add below is variable-time Python; the recipient side
+    runs it with the node's long-term static key against attacker-chosen
+    points, so the scalar is BLINDED per call (k + r*n for random 128-bit
+    r): timing varies with the blinded scalar, which is independent of
+    the key, defeating remote timing accumulation."""
+    import secrets as _secrets
+
+    k = private_key.private_numbers().private_value % _SECP_N
+    k = k + (_secrets.randbits(128) + 1) * _SECP_N
+    nums = public_key.public_numbers()
+    px, py = nums.x, nums.y
+    # jacobian double-and-add (a = 0)
+    X, Y, Z = 1, 1, 0  # infinity
+    qx, qy, qz = px, py, 1
+    for bit in bin(k)[2:]:
+        # double
+        if Z != 0:
+            A = X * X % _SECP_P
+            B = Y * Y % _SECP_P
+            C = B * B % _SECP_P
+            D = 2 * ((X + B) * (X + B) - A - C) % _SECP_P
+            E = 3 * A % _SECP_P
+            X2 = (E * E - 2 * D) % _SECP_P
+            Y2 = (E * (D - X2) - 8 * C) % _SECP_P
+            Z2 = 2 * Y * Z % _SECP_P
+            X, Y, Z = X2, Y2, Z2
+        if bit == "1":
+            if Z == 0:
+                X, Y, Z = qx, qy, qz
+            else:
+                Z1Z1 = Z * Z % _SECP_P
+                U2 = qx * Z1Z1 % _SECP_P
+                S2 = qy * Z * Z1Z1 % _SECP_P
+                H = (U2 - X) % _SECP_P
+                r = (S2 - Y) % _SECP_P
+                if H == 0:
+                    if r != 0:
+                        X, Y, Z = 1, 1, 0
+                        continue
+                    # doubling case unreachable for k < n with P of order n
+                H2 = H * H % _SECP_P
+                H3 = H * H2 % _SECP_P
+                XH2 = X * H2 % _SECP_P
+                X3 = (r * r - H3 - 2 * XH2) % _SECP_P
+                Y3 = (r * (XH2 - X3) - Y * H3) % _SECP_P
+                Z3 = Z * H % _SECP_P
+                X, Y, Z = X3, Y3, Z3
+    assert Z != 0, "ECDH with identity result"
+    zi = pow(Z, -1, _SECP_P)
+    zi2 = zi * zi % _SECP_P
+    ax = X * zi2 % _SECP_P
+    ay = Y * zi * zi2 % _SECP_P
+    return bytes([0x02 | (ay & 1)]) + ax.to_bytes(32, "big")
+
 
 PROTOCOL_ID = b"discv5"
 VERSION = b"\x00\x01"
@@ -375,7 +439,7 @@ class Discv5Node:
         remote_pub = ec.EllipticCurvePublicKey.from_encoded_point(
             ec.SECP256K1(), enr.pairs[b"secp256k1"]
         )
-        secret = eph.exchange(ec.ECDH(), remote_pub)
+        secret = _ecdh_compressed(eph, remote_pub)
         send_key, recv_key = _session_keys(secret, self.node_id, dest, challenge_data)
         self.sessions[dest] = _Session(send_key, recv_key)
         id_digest = hashlib.sha256(
@@ -429,7 +493,7 @@ class Discv5Node:
         eph_pub = ec.EllipticCurvePublicKey.from_encoded_point(
             ec.SECP256K1(), bytes(eph_pub_bytes)
         )
-        secret = self.key.exchange(ec.ECDH(), eph_pub)
+        secret = _ecdh_compressed(self.key, eph_pub)
         # keys derived with (initiator, recipient) = (them, us)
         their_send, our_send = _session_keys(secret, src_id, self.node_id, challenge_data)
         self.sessions[src_id] = _Session(our_send, their_send)

@@ -16,11 +16,15 @@ Mechanics implemented (the v1.1 core the reference relies on):
 * message cache: `mcache_gossip`=3 windows advertised, `mcache_len`=6
   kept for IWANT service
 * seen-id dedup with TTL
-* peer scoring (decaying counters): P1 time-in-mesh, P2 first
-  deliveries, P4 invalid messages, P7 behaviour penalty, with the
-  gossip/publish/graylist thresholds of lodestar's
-  `scoringParameters.ts`. Scores gate mesh admission, gossip emission
-  and (below graylist) RPC processing.
+* PER-TOPIC peer scoring (decaying counters): P1 time-in-mesh, P2
+  first deliveries, P3 mesh-delivery-rate deficit (squared) with
+  activation + duplicate window, P3b sticky mesh-failure penalty
+  captured at prune, P4 invalid messages — each weighted by
+  `TopicScoreParams` (eth2 kinds via `eth2_topic_score_params`,
+  reference `scoringParameters.ts:124-148`) — plus the global P7
+  behaviour penalty and the gossip/publish/graylist thresholds.
+  Scores gate mesh admission, gossip emission and (below graylist)
+  RPC processing.
 
 Validation: the node wires `set_validator(fn)`; `fn(topic, raw_payload,
 peer) -> (verdict, ssz_bytes)` with verdict in "accept" | "ignore" |
@@ -40,7 +44,7 @@ from lodestar_tpu.utils.snappy import compress
 
 from .gossip import compute_message_id
 
-__all__ = ["GossipSub", "GossipParams"]
+__all__ = ["GossipSub", "GossipParams", "TopicScoreParams", "eth2_topic_score_params"]
 
 PROTOCOL_ID = "/meshsub/1.1.0"
 
@@ -198,26 +202,159 @@ class GossipParams:
     DECAY = 0.96
 
 
+class TopicScoreParams:
+    """Per-topic scoring weights (reference `scoringParameters.ts`
+    TopicScoreParams, computed per topic kind at `:124-148`). Defaults
+    reproduce the pre-r5 global behavior (no mesh-delivery penalty)."""
+
+    __slots__ = (
+        "topic_weight",
+        "time_in_mesh_weight", "time_in_mesh_cap",
+        "first_deliveries_weight", "first_deliveries_cap", "first_deliveries_decay",
+        "mesh_deliveries_weight", "mesh_deliveries_threshold",
+        "mesh_deliveries_cap", "mesh_deliveries_decay",
+        "mesh_deliveries_activation_sec", "mesh_deliveries_window_sec",
+        "mesh_failure_weight", "mesh_failure_decay",
+        "invalid_weight", "invalid_decay",
+    )
+
+    def __init__(self, **kw):
+        self.topic_weight = 1.0
+        self.time_in_mesh_weight = 0.03333
+        self.time_in_mesh_cap = 300.0
+        self.first_deliveries_weight = 1.0
+        self.first_deliveries_cap = 100.0
+        self.first_deliveries_decay = 0.96
+        # P3 mesh message delivery rate: a mesh peer that delivers fewer
+        # than `threshold` messages per decay window (after `activation`
+        # seconds in mesh) accrues a squared deficit penalty
+        self.mesh_deliveries_weight = 0.0  # off unless a kind enables it
+        self.mesh_deliveries_threshold = 0.0
+        self.mesh_deliveries_cap = 100.0
+        self.mesh_deliveries_decay = 0.96
+        self.mesh_deliveries_activation_sec = 10.0
+        self.mesh_deliveries_window_sec = 2.0
+        # P3b sticky mesh-failure penalty (deficit^2 captured at prune)
+        self.mesh_failure_weight = 0.0
+        self.mesh_failure_decay = 0.9
+        self.invalid_weight = -100.0
+        self.invalid_decay = 0.96
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def eth2_topic_score_params(kind: str) -> TopicScoreParams:
+    """Eth2 per-kind params, shaped after the reference's generated table
+    (`scoringParameters.ts:124-148`): block/aggregate topics carry heavy
+    weight with mesh-delivery penalties; the 64 attestation subnets split
+    one unit of weight; ephemeral low-rate topics score deliveries only."""
+    if kind in ("beacon_block", "beacon_block_and_blobs_sidecar"):
+        return TopicScoreParams(
+            topic_weight=0.5,
+            mesh_deliveries_weight=-0.5, mesh_deliveries_threshold=3.0,
+            mesh_failure_weight=-0.5,
+        )
+    if kind == "beacon_aggregate_and_proof":
+        return TopicScoreParams(
+            topic_weight=0.5,
+            mesh_deliveries_weight=-0.1, mesh_deliveries_threshold=8.0,
+            mesh_failure_weight=-0.1,
+        )
+    if kind.startswith("beacon_attestation"):
+        return TopicScoreParams(
+            topic_weight=1.0 / 64.0,
+            mesh_deliveries_weight=-0.02, mesh_deliveries_threshold=4.0,
+            mesh_failure_weight=-0.02,
+        )
+    if kind.startswith("sync_committee"):
+        return TopicScoreParams(topic_weight=1.0 / 4.0)
+    # voluntary_exit / slashings / light-client: rare messages, P2 only
+    return TopicScoreParams(topic_weight=0.05)
+
+
+class _TopicStats:
+    __slots__ = ("mesh_since", "first_deliveries", "mesh_deliveries", "mesh_failure", "invalid")
+
+    def __init__(self):
+        self.mesh_since: float | None = None
+        self.first_deliveries = 0.0
+        self.mesh_deliveries = 0.0
+        self.mesh_failure = 0.0
+        self.invalid = 0.0
+
+
+_DEFAULT_TOPIC_PARAMS = TopicScoreParams()
+
+
 class _PeerScore:
     def __init__(self):
-        self.mesh_since: dict[str, float] = {}  # topic -> graft time
-        self.first_deliveries = 0.0
-        self.invalid = 0.0
+        self.topics: dict[str, _TopicStats] = {}
         self.behaviour = 0.0
+        self.disconnected_at: float | None = None
 
-    def decay(self, p: GossipParams) -> None:
-        self.first_deliveries *= p.DECAY
-        self.invalid *= p.DECAY
+    def topic(self, t: str) -> _TopicStats:
+        ts = self.topics.get(t)
+        if ts is None:
+            ts = self.topics[t] = _TopicStats()
+        return ts
+
+    def graft(self, topic: str, now: float) -> None:
+        ts = self.topic(topic)
+        if ts.mesh_since is None:
+            ts.mesh_since = now
+            ts.mesh_deliveries = 0.0
+
+    def prune(self, topic: str, params: TopicScoreParams, now: float) -> None:
+        """Leave the mesh for `topic`, capturing the P3b sticky penalty if
+        the peer was under-delivering (gossipsub v1.1 spec / reference
+        meshFailurePenalty)."""
+        ts = self.topics.get(topic)
+        if ts is None or ts.mesh_since is None:
+            return
+        if (
+            params.mesh_deliveries_weight != 0.0
+            and now - ts.mesh_since >= params.mesh_deliveries_activation_sec
+        ):
+            deficit = max(0.0, params.mesh_deliveries_threshold - ts.mesh_deliveries)
+            ts.mesh_failure += deficit * deficit
+        ts.mesh_since = None
+        ts.mesh_deliveries = 0.0
+
+    def decay(self, p: GossipParams, params_for: "callable") -> None:
         self.behaviour *= p.DECAY
+        for t, ts in self.topics.items():
+            tp = params_for(t)
+            ts.first_deliveries *= tp.first_deliveries_decay
+            ts.mesh_deliveries *= tp.mesh_deliveries_decay
+            ts.mesh_failure *= tp.mesh_failure_decay
+            ts.invalid *= tp.invalid_decay
 
-    def value(self, p: GossipParams, now: float) -> float:
+    def value(self, p: GossipParams, now: float, params_for: "callable") -> float:
         s = 0.0
-        for since in self.mesh_since.values():
-            s += min(now - since, p.TIME_IN_MESH_CAP) * p.TIME_IN_MESH_WEIGHT
-        s += min(self.first_deliveries, p.FIRST_DELIVERY_CAP) * p.FIRST_DELIVERY_WEIGHT
-        # P4/P7 are quadratic in their counters (gossipsub v1.1 spec)
-        s += self.invalid * self.invalid * p.INVALID_MESSAGE_WEIGHT
-        s += self.behaviour * self.behaviour * p.BEHAVIOUR_PENALTY_WEIGHT
+        for t, ts in self.topics.items():
+            tp = params_for(t)
+            topic_score = 0.0
+            if ts.mesh_since is not None:
+                topic_score += (
+                    min(now - ts.mesh_since, tp.time_in_mesh_cap)
+                    * tp.time_in_mesh_weight
+                )
+                # P3: squared delivery deficit while activated in mesh
+                if (
+                    tp.mesh_deliveries_weight != 0.0
+                    and now - ts.mesh_since >= tp.mesh_deliveries_activation_sec
+                    and ts.mesh_deliveries < tp.mesh_deliveries_threshold
+                ):
+                    deficit = tp.mesh_deliveries_threshold - ts.mesh_deliveries
+                    topic_score += deficit * deficit * tp.mesh_deliveries_weight
+            topic_score += (
+                min(ts.first_deliveries, tp.first_deliveries_cap)
+                * tp.first_deliveries_weight
+            )
+            topic_score += ts.mesh_failure * tp.mesh_failure_weight  # P3b
+            topic_score += ts.invalid * ts.invalid * tp.invalid_weight  # P4
+            s += topic_score * tp.topic_weight
+        s += self.behaviour * self.behaviour * p.BEHAVIOUR_PENALTY_WEIGHT  # P7
         return s
 
 
@@ -236,6 +373,7 @@ class GossipSub:
         self.fanout: dict[str, set[str]] = {}
         self.backoff: dict[tuple[str, str], float] = {}  # (topic, peer) -> until
         self.scores: dict[str, _PeerScore] = {}
+        self.topic_params: dict[str, TopicScoreParams] = {}
         self.seen: dict[bytes, float] = {}  # msg id -> first-seen time
         self.mcache: list[list[tuple[bytes, str, bytes]]] = [[]]  # windows of (id, topic, raw)
         self.mcache_index: dict[bytes, tuple[str, bytes]] = {}
@@ -284,6 +422,9 @@ class GossipSub:
     # -- peer/stream plumbing --------------------------------------------------
 
     async def _on_peer(self, peer_id: str) -> None:
+        sc = self.scores.get(peer_id)
+        if sc is not None:
+            sc.disconnected_at = None
         """New connection: open our outbound RPC stream, announce subs."""
         self.scores.setdefault(peer_id, _PeerScore())
         try:
@@ -300,10 +441,17 @@ class GossipSub:
     def _drop_peer(self, peer_id: str) -> None:
         self._streams.pop(peer_id, None)
         self.peer_topics.pop(peer_id, None)
-        for peers in self.mesh.values():
-            peers.discard(peer_id)
+        for topic, peers in self.mesh.items():
+            if peer_id in peers:
+                # P3b capture + mesh_since reset: without the prune() the
+                # score would keep charging a frozen delivery deficit and
+                # permanently reject the peer on reconnect
+                self._mesh_remove(peer_id, topic)
         for peers in self.fanout.values():
             peers.discard(peer_id)
+        sc = self.scores.get(peer_id)
+        if sc is not None:
+            sc.disconnected_at = self.now()
 
     async def _send_rpc(self, peer_id: str, rpc: bytes) -> bool:
         stream = self._streams.get(peer_id)
@@ -346,9 +494,22 @@ class GossipSub:
 
     # -- RPC handling ----------------------------------------------------------
 
+    def set_topic_params(self, topic: str, params: TopicScoreParams) -> None:
+        self.topic_params[str(topic)] = params
+
+    def _params_for(self, topic: str) -> TopicScoreParams:
+        return self.topic_params.get(topic, _DEFAULT_TOPIC_PARAMS)
+
     def _score(self, peer_id: str) -> float:
         sc = self.scores.get(peer_id)
-        return sc.value(self.p, self.now()) if sc else 0.0
+        return sc.value(self.p, self.now(), self._params_for) if sc else 0.0
+
+    def _mesh_remove(self, peer_id: str, topic: str) -> None:
+        """Drop a peer from a topic mesh, applying the P3b capture."""
+        self.mesh.get(topic, set()).discard(peer_id)
+        sc = self.scores.get(peer_id)
+        if sc:
+            sc.prune(topic, self._params_for(topic), self.now())
 
     def _penalize(self, peer_id: str, units: float) -> None:
         self.scores.setdefault(peer_id, _PeerScore()).behaviour += units
@@ -362,10 +523,7 @@ class GossipSub:
         for topic in rpc["graft"]:
             await self._on_graft(peer_id, topic)
         for topic, backoff in rpc["prune"]:
-            self.mesh.get(topic, set()).discard(peer_id)
-            sc = self.scores.get(peer_id)
-            if sc:
-                sc.mesh_since.pop(topic, None)
+            self._mesh_remove(peer_id, topic)
             self.backoff[(topic, peer_id)] = self.now() + int(backoff)
         for topic, data in rpc["publish"]:
             await self._on_message(peer_id, topic, data)
@@ -386,13 +544,25 @@ class GossipSub:
             await self._send_rpc(peer_id, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
             return
         self.mesh.setdefault(topic, set()).add(peer_id)
-        self.scores.setdefault(peer_id, _PeerScore()).mesh_since.setdefault(topic, self.now())
+        self.scores.setdefault(peer_id, _PeerScore()).graft(topic, self.now())
 
     async def _on_message(self, peer_id: str, topic: str, raw: bytes) -> None:
         msg_id = compute_message_id(raw)
         now = self.now()
-        if msg_id in self.seen:
+        first_seen = self.seen.get(msg_id)
+        if first_seen is not None:
             self.metrics["duplicates"] += 1
+            # P3 counts near-duplicate deliveries from mesh peers: a peer
+            # forwarding within the delivery window is doing its mesh job
+            # even when another peer was first (gossipsub v1.1 spec)
+            tp = self._params_for(topic)
+            if (
+                topic in self.topics
+                and peer_id in self.mesh.get(topic, set())
+                and now - first_seen <= tp.mesh_deliveries_window_sec
+            ):
+                ts = self.scores.setdefault(peer_id, _PeerScore()).topic(topic)
+                ts.mesh_deliveries = min(ts.mesh_deliveries + 1.0, tp.mesh_deliveries_cap)
             return
         self.seen[msg_id] = now
         verdict = "accept"
@@ -402,12 +572,22 @@ class GossipSub:
         if verdict == "reject":
             self.metrics["rejected"] += 1
             sc = self.scores.setdefault(peer_id, _PeerScore())
-            sc.invalid += 1.0
+            if topic in self.topics:
+                sc.topic(topic).invalid += 1.0
+            else:
+                # unknown/junk topic strings must not allocate per-topic
+                # stats (unbounded attacker-controlled keys): charge the
+                # global behaviour penalty instead
+                sc.behaviour += 1.0
             return
         if verdict == "ignore":
             return
         sc = self.scores.setdefault(peer_id, _PeerScore())
-        sc.first_deliveries += 1.0
+        ts = sc.topic(topic)
+        tp = self._params_for(topic)
+        ts.first_deliveries = min(ts.first_deliveries + 1.0, tp.first_deliveries_cap)
+        if peer_id in self.mesh.get(topic, set()):
+            ts.mesh_deliveries = min(ts.mesh_deliveries + 1.0, tp.mesh_deliveries_cap)
         self.metrics["delivered"] += 1
         self._mcache_put(msg_id, topic, raw)
         await self._forward(topic, raw, exclude={peer_id})
@@ -522,7 +702,7 @@ class GossipSub:
             mesh = self.mesh.setdefault(topic, set())
             # kick negative-score peers
             for peer_id in [pid for pid in mesh if self._score(pid) < 0]:
-                mesh.discard(peer_id)
+                self._mesh_remove(peer_id, topic)
                 await self._send_rpc(peer_id, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
             if len(mesh) < self.p.D_LO:
                 candidates = [
@@ -534,16 +714,13 @@ class GossipSub:
                 ]
                 for pid in candidates[: self.p.D - len(mesh)]:
                     mesh.add(pid)
-                    self.scores.setdefault(pid, _PeerScore()).mesh_since.setdefault(topic, now)
+                    self.scores.setdefault(pid, _PeerScore()).graft(topic, now)
                     await self._send_rpc(pid, encode_rpc(graft=[topic]))
             elif len(mesh) > self.p.D_HI:
                 # prune down to D, lowest scores first
                 ranked = sorted(mesh, key=self._score)
                 for pid in ranked[: len(mesh) - self.p.D]:
-                    mesh.discard(pid)
-                    sc = self.scores.get(pid)
-                    if sc:
-                        sc.mesh_since.pop(topic, None)
+                    self._mesh_remove(pid, topic)
                     await self._send_rpc(pid, encode_rpc(prune=[(topic, self.p.PRUNE_BACKOFF_SEC)]))
         # gossip: IHAVE recent ids to D_LAZY non-mesh peers per topic
         window = self.mcache[: self.p.MCACHE_GOSSIP]
@@ -567,7 +744,18 @@ class GossipSub:
                 self.mcache_index.pop(msg_id, None)
         # decay scores, expire seen + backoff
         for sc in self.scores.values():
-            sc.decay(self.p)
+            sc.decay(self.p, self._params_for)
+        # evict decayed score state of disconnected peers (reference
+        # retainScore): bounds memory against peer-id churn
+        retain = self.p.SEEN_TTL_SEC
+        for pid in list(self.scores):
+            sc = self.scores[pid]
+            if (
+                pid not in self._streams
+                and sc.disconnected_at is not None
+                and now - sc.disconnected_at > retain
+            ):
+                del self.scores[pid]
         cutoff = now - self.p.SEEN_TTL_SEC
         self.seen = {k: v for k, v in self.seen.items() if v >= cutoff}
         self.backoff = {k: v for k, v in self.backoff.items() if v > now}

@@ -30,16 +30,17 @@ class ReqRespBeaconNode(ReqResp):
         # embedding (network service, direct tests) can serve V2/LC chunks
         from lodestar_tpu.config import FORK_ORDER, create_beacon_config
 
-        try:
+        if getattr(chain, "cfg", None) is None:
+            # dev/test chains without a chain config: serve zero-digest
+            # context; digest_to_fork stays None so a client half decodes
+            # fork-INVARIANT chunks with static types (and refuses
+            # fork-variant ones loudly) instead of mis-deserializing
+            self.set_fork_context(lambda f: b"\x00\x00\x00\x00", None)
+        else:
             gvr = bytes(chain.get_head_state().genesis_validators_root)
             bc = create_beacon_config(chain.cfg, gvr)
             digest_to_fork = {bc.fork_digest(f): f for f in FORK_ORDER}
             self.set_fork_context(bc.fork_digest, digest_to_fork.get)
-        except Exception:
-            # dev/test chains without a chain config: serve zero-digest
-            # context; digest_to_fork stays None so a client half falls
-            # back to static chunk types instead of raising unknown-digest
-            self.set_fork_context(lambda f: b"\x00\x00\x00\x00", None)
         self.register_handler(_pid("status"), self._on_status)
         self.register_handler(_pid("ping"), self._on_ping)
         self.register_handler(_pid("metadata"), self._on_metadata)
@@ -109,6 +110,11 @@ class ReqRespBeaconNode(ReqResp):
         t = ssz_types(self.chain.p)
         md = t.phase0.Metadata.default()
         md.seq_number = self._seq
+        net = getattr(self.chain, "network", None)
+        if net is not None and hasattr(net, "attnets_bytes"):
+            raw = net.attnets_bytes()
+            for i in range(len(md.attnets)):
+                md.attnets[i] = bool(raw[i // 8] & (1 << (i % 8)))
         yield md
 
     def _block_fork(self, signed) -> str:

@@ -125,9 +125,8 @@ class Libp2pBeaconNetwork:
         self.beacon_cfg = create_beacon_config(self.chain.cfg, gvr)
         for fork in FORK_ORDER:
             self._digest_to_fork[self.beacon_cfg.fork_digest(fork)] = fork
-        self.reqresp.set_fork_context(
-            self.beacon_cfg.fork_digest, self._digest_to_fork.get
-        )
+        # fork-context wiring lives in ReqRespBeaconNode.__init__ (single
+        # source); nothing to install here
         port = await self.host.listen(host_addr)
         self.gossip.start()
         await self._subscribe_core_topics()
@@ -151,7 +150,11 @@ class Libp2pBeaconNetwork:
                 ip=host_addr,
                 port=self._discv5_port,
                 tcp_port=port,
-                enr_extra={b"eth2": self.current_fork_digest()},
+                enr_extra={
+                    b"eth2": self.current_fork_digest(),
+                    b"attnets": self.attnets_bytes(),
+                    b"syncnets": self.syncnets_bytes(),
+                },
                 bootnodes=self._discv5_bootnodes,
             )
             await self.discv5.start()
@@ -203,7 +206,17 @@ class Libp2pBeaconNetwork:
                     self.discv5.enr.sign(self.discv5.key)
                 await self.discv5.bootstrap(rounds=1)
                 now = _time.monotonic()
-                for enr in self.discv5.enr_source():
+                # subnet-aware ordering: ENRs advertising attnets we
+                # subscribe to dial first (reference peers/discover.ts
+                # subnet-driven discovery over ENR attnets bitfields)
+                wanted = set(range(min(self.subscribe_subnets, 64)))
+                candidates = sorted(
+                    self.discv5.enr_source(),
+                    key=lambda e: not any(
+                        self.enr_has_attnet(e, s) for s in wanted
+                    ),
+                )
+                for enr in candidates:
                     if enr.node_id == self.discv5.node_id:
                         continue
                     if enr.pairs.get(b"eth2", digest) != digest:
@@ -258,6 +271,27 @@ class Libp2pBeaconNetwork:
         fork = self.chain.fork_name_at_slot(self.chain.fork_choice.current_slot)
         return self.beacon_cfg.fork_digest(fork)
 
+    def attnets_bytes(self) -> bytes:
+        """SSZ Bitvector[64] of subscribed attestation subnets — the value
+        advertised in the ENR `attnets` pair and in metadata (reference
+        `network/metadata.ts:49`)."""
+        bits = bytearray(8)
+        for subnet in range(min(self.subscribe_subnets, 64)):
+            bits[subnet // 8] |= 1 << (subnet % 8)
+        return bytes(bits)
+
+    def syncnets_bytes(self) -> bytes:
+        """SSZ Bitvector[4] of sync-committee subnets (none yet)."""
+        return b"\x00"
+
+    @staticmethod
+    def enr_has_attnet(enr, subnet: int) -> bool:
+        """Does a discovered ENR advertise attestation subnet `subnet`?"""
+        raw = enr.pairs.get(b"attnets")
+        if not raw or subnet // 8 >= len(raw):
+            return False
+        return bool(raw[subnet // 8] & (1 << (subnet % 8)))
+
     async def _subscribe_core_topics(self) -> None:
         digest = self.current_fork_digest()
         kinds = [
@@ -272,10 +306,17 @@ class Libp2pBeaconNetwork:
             kinds.append("bls_to_execution_change")
         if fork == "deneb":
             kinds[0] = "beacon_block_and_blobs_sidecar"
+        from lodestar_tpu.network.gossipsub import eth2_topic_score_params
+
         for kind in kinds:
-            await self.gossip.subscribe(topic_string(kind, digest))
+            topic = topic_string(kind, digest)
+            self.gossip.set_topic_params(topic, eth2_topic_score_params(kind))
+            await self.gossip.subscribe(topic)
         for subnet in range(self.subscribe_subnets):
-            await self.gossip.subscribe(topic_string(f"beacon_attestation_{subnet}", digest))
+            kind = f"beacon_attestation_{subnet}"
+            topic = topic_string(kind, digest)
+            self.gossip.set_topic_params(topic, eth2_topic_score_params(kind))
+            await self.gossip.subscribe(topic)
 
     # -- gossip ingress --------------------------------------------------------
 

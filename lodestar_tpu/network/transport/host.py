@@ -280,9 +280,15 @@ class Libp2pHost:
                 raise ConnectionResetError("stream closed during negotiation")
             return data
 
-        leftover = await asyncio.wait_for(
-            negotiate_out(send, recv, protocol_id), timeout=10.0
-        )
+        try:
+            leftover = await asyncio.wait_for(
+                negotiate_out(send, recv, protocol_id), timeout=10.0
+            )
+        except BaseException:
+            # a refused/failed negotiation must not leak the substream —
+            # V2-first dialing makes 'na' an expected per-request event
+            stream.reset()
+            raise
         if leftover:  # pipelined response bytes: back to the front
             stream._buf[0:0] = leftover
         stream.protocol = protocol_id

@@ -34,6 +34,11 @@ class Protocol:
     # response_type_by_fork resolves the chunk type from the fork name.
     context: str = CONTEXT_NONE
     response_type_by_fork: Callable[[str], object] | None = None
+    # True when the chunk SSZ layout is the same for every fork (LC
+    # containers, blob sidecars): a client without a digest mapping may
+    # then decode with the static type; fork-VARIANT protocols (blocks
+    # V2) must fail loudly instead of mis-deserializing
+    fork_invariant: bool = False
 
     def resolve_response_type(self, fork: str | None):
         if self.context == CONTEXT_FORK_DIGEST and fork is not None:
@@ -108,6 +113,7 @@ BEACON_PROTOCOLS: dict[str, Protocol] = {
             128,
             context=CONTEXT_FORK_DIGEST,
             response_type_by_fork=lambda fork: _t().deneb.BlobsSidecar,
+            fork_invariant=True,
         ),
         # light-client protocols (reference protocols.ts LightClient* —
         # all carry ForkDigest context; our LC containers are
@@ -119,6 +125,7 @@ BEACON_PROTOCOLS: dict[str, Protocol] = {
             1,
             context=CONTEXT_FORK_DIGEST,
             response_type_by_fork=lambda fork: _t().LightClientBootstrap,
+            fork_invariant=True,
         ),
         Protocol(
             _pid("light_client_updates_by_range"),
@@ -127,6 +134,7 @@ BEACON_PROTOCOLS: dict[str, Protocol] = {
             128,
             context=CONTEXT_FORK_DIGEST,
             response_type_by_fork=lambda fork: _t().LightClientUpdate,
+            fork_invariant=True,
         ),
         Protocol(
             _pid("light_client_finality_update"),
@@ -135,6 +143,7 @@ BEACON_PROTOCOLS: dict[str, Protocol] = {
             1,
             context=CONTEXT_FORK_DIGEST,
             response_type_by_fork=lambda fork: _t().LightClientFinalityUpdate,
+            fork_invariant=True,
         ),
         Protocol(
             _pid("light_client_optimistic_update"),
@@ -143,6 +152,7 @@ BEACON_PROTOCOLS: dict[str, Protocol] = {
             1,
             context=CONTEXT_FORK_DIGEST,
             response_type_by_fork=lambda fork: _t().LightClientOptimisticUpdate,
+            fork_invariant=True,
         ),
     ]
 }

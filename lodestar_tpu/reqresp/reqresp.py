@@ -188,10 +188,14 @@ class ReqResp:
                                 f"unknown fork digest {context.hex()}"
                             )
                         typ = proto.resolve_response_type(fork)
+                    elif fork_ctx and not proto.fork_invariant:
+                        # decoding a fork-variant chunk without a digest
+                        # mapping would silently mis-deserialize: fail loud
+                        raise ReqRespError(
+                            "fork context not configured for "
+                            f"{protocol_id}"
+                        )
                     else:
-                        # no digest mapping installed: static type. Safe
-                        # only for fork-invariant payloads (LC containers);
-                        # block V2 clients must set_fork_context first.
                         typ = proto.response_type()
                     out.append(typ.deserialize(payload))
                     if len(out) >= limit:

@@ -114,7 +114,7 @@ def test_reject_penalizes_and_blocks_propagation():
         assert gb.metrics["rejected"] == 1
         # the rejecting node penalized the sender
         a_id = ha.peer_id
-        assert gb.scores[a_id].invalid > 0
+        assert gb.scores[a_id].topic(TOPIC).invalid > 0
 
         for h in (ha, hb, hc):
             await h.close()
@@ -148,3 +148,61 @@ def test_iwant_serves_from_mcache():
         await hb.close()
 
     asyncio.run(run())
+
+
+def test_p3_mesh_delivery_penalty_prunes_lazy_peer():
+    """A mesh peer that stops delivering on a P3-enabled topic accrues a
+    squared delivery deficit, its score goes negative, and the next
+    heartbeat prunes it (VERDICT r5: per-topic TopicScoreParams with
+    mesh-delivery penalties, reference scoringParameters.ts:124-148)."""
+    from lodestar_tpu.network.gossipsub import TopicScoreParams, eth2_topic_score_params
+
+    clock = [0.0]
+
+    class _FakeHost:
+        on_peer_connect = None
+        on_peer_disconnect = None
+
+        def set_handler(self, *_):
+            pass
+
+    gs = GossipSub(_FakeHost(), time_fn=lambda: clock[0])
+    topic = "/eth2/00000000/beacon_block/ssz_snappy"
+    gs.set_topic_params(
+        topic,
+        TopicScoreParams(
+            topic_weight=0.5,
+            mesh_deliveries_weight=-0.5,
+            mesh_deliveries_threshold=4.0,
+            mesh_deliveries_activation_sec=5.0,
+            mesh_failure_weight=-0.5,
+        ),
+    )
+    gs.topics.add(topic)
+    gs.mesh[topic] = {"lazy", "good"}
+    from lodestar_tpu.network.gossipsub import _PeerScore
+
+    for pid in ("lazy", "good"):
+        sc = gs.scores[pid] = _PeerScore()
+        sc.graft(topic, clock[0])
+    # the good peer keeps delivering; the lazy peer delivers nothing
+    gs.scores["good"].topic(topic).mesh_deliveries = 10.0
+    gs.scores["good"].topic(topic).first_deliveries = 10.0
+
+    clock[0] = 10.0  # past the activation window
+    assert gs._score("good") > 0
+    assert gs._score("lazy") < 0, "delivery deficit must drive the score negative"
+
+    async def hb():
+        await gs.heartbeat()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(hb())
+    assert "lazy" not in gs.mesh[topic], "heartbeat must prune the lazy peer"
+    assert "good" in gs.mesh[topic]
+    # P3b: the prune captured a sticky mesh-failure penalty
+    assert gs.scores["lazy"].topic(topic).mesh_failure > 0
+    assert gs._score("lazy") < 0
+
+    # eth2 kinds come with P3 enabled for the heavy topics
+    assert eth2_topic_score_params("beacon_block").mesh_deliveries_weight < 0
+    assert eth2_topic_score_params("beacon_attestation_3").topic_weight < 0.1
