@@ -1,0 +1,370 @@
+"""Generate the round-5 spec-test fixtures: shuffling, rewards,
+ssz_static and fork_choice runners (reference `test/spec/presets/
+{shuffling,rewards,ssz_static,fork_choice}.ts`).
+
+Independence: every expected value in these fixtures comes from a NAIVE
+second implementation, never from the code under test —
+
+  * shuffling mappings  <- naive_stf.compute_shuffled_index (spec loop)
+  * rewards deltas      <- naive_stf component deltas (spec loops)
+  * ssz_static roots    <- naive_ssz.naive_root (spec merkleizer)
+  * fork_choice heads   <- a naive LMD-GHOST recomputation from scratch
+
+The fork_choice fixtures use a documented SIMPLIFIED step format (the
+official format carries full blocks/states; offline we drive the store
+directly): steps.yaml = [{tick}|{block}|{attestation}|{checks}] over
+synthetic block summaries, balances.yaml = effective balances.
+
+Usage: python tests/spec/generate_more_vectors.py
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+
+import yaml
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", ".."))
+sys.path.insert(0, HERE)
+
+from lodestar_tpu import params  # noqa: E402
+
+params.set_active_preset("minimal")
+
+import naive_ssz  # noqa: E402
+import naive_stf as N  # noqa: E402
+from generate_stf_vectors import (  # noqa: E402
+    P,
+    T,
+    _attested_boundary_state,
+    _state_bytes,
+    _write_case,
+)
+
+ROOT = os.path.join(HERE, "vectors", "tests", "minimal", "phase0")
+
+
+# --- shuffling ----------------------------------------------------------------
+
+
+def gen_shuffling() -> None:
+    import hashlib
+
+    cases = [
+        (hashlib.sha256(b"shuffle-seed-%d" % i).digest(), count)
+        for i, count in enumerate((1, 2, 8, 33, 100))
+    ]
+    for i, (seed, count) in enumerate(cases):
+        mapping = [N.compute_shuffled_index(j, count, seed) for j in range(count)]
+        _write_case("shuffling", "core", f"shuffle_{i}", {
+            "mapping.yaml": {
+                "seed": "0x" + seed.hex(),
+                "count": count,
+                "mapping": mapping,
+            },
+        })
+
+
+# --- rewards ------------------------------------------------------------------
+
+
+def gen_rewards() -> None:
+    state = _attested_boundary_state()
+    # a slashed validator exercises the unslashed-indices filters
+    state.validators[5].slashed = True
+    components = {
+        "source_deltas": N.get_source_deltas(state.copy()),
+        "target_deltas": N.get_target_deltas(state.copy()),
+        "head_deltas": N.get_head_deltas(state.copy()),
+        "inclusion_delay_deltas": N.get_inclusion_delay_deltas(state.copy()),
+        "inactivity_penalty_deltas": N.get_inactivity_penalty_deltas(state.copy()),
+    }
+    files = {"pre.ssz": _state_bytes(state)}
+    files["deltas.yaml"] = {
+        name: {"rewards": list(map(int, r)), "penalties": list(map(int, p))}
+        for name, (r, p) in components.items()
+    }
+    _write_case("rewards", "basic", "attested_two_epochs", files)
+
+
+# --- ssz_static ---------------------------------------------------------------
+
+SSZ_STATIC_TYPES = [
+    "Checkpoint",
+    "AttestationData",
+    "Attestation",
+    "IndexedAttestation",
+    "PendingAttestation",
+    "Deposit",
+    "DepositData",
+    "BeaconBlockHeader",
+    "ProposerSlashing",
+    "AttesterSlashing",
+    "VoluntaryExit",
+    "SignedVoluntaryExit",
+    "Eth1Data",
+    "Fork",
+    "ForkData",
+    "SigningData",
+    "HistoricalBatch",
+    "Validator",
+]
+
+
+def gen_ssz_static() -> None:
+    import random
+
+    rng = random.Random(1234)
+    for name in SSZ_STATIC_TYPES:
+        typ = getattr(T, name)
+        for i in range(2):
+            value = naive_ssz.random_value(typ, rng)
+            _write_case("ssz_static", name, f"ssz_random_{i}", {
+                "serialized.ssz": typ.serialize(value),
+                "roots.yaml": {"root": "0x" + naive_ssz.naive_root(typ, value).hex()},
+            })
+    # the big ones once each
+    for name, ns in (("BeaconBlock", "phase0"), ("BeaconState", "phase0")):
+        typ = getattr(getattr(T, ns), name)
+        value = naive_ssz.random_value(typ, rng)
+        _write_case("ssz_static", name, "ssz_random_0", {
+            "serialized.ssz": typ.serialize(value),
+            "roots.yaml": {"root": "0x" + naive_ssz.naive_root(typ, value).hex()},
+        })
+
+
+# --- fork choice --------------------------------------------------------------
+
+
+def _naive_ghost(blocks: dict, votes: dict, balances: list[int], justified_root: str) -> str:
+    """From-scratch LMD-GHOST: weight of a node = sum of balances of
+    validators whose latest vote lands in its subtree; descend from the
+    justified root picking the heaviest child (ties: higher root hex —
+    scenarios avoid ties anyway)."""
+    children: dict[str, list[str]] = {}
+    for root, b in blocks.items():
+        children.setdefault(b["parent"], []).append(root)
+
+    def in_subtree(node: str, root: str) -> bool:
+        while node is not None:
+            if node == root:
+                return True
+            node = blocks.get(node, {}).get("parent")
+        return False
+
+    def weight(root: str) -> int:
+        total = 0
+        for vi, vote_root in votes.items():
+            if vote_root in blocks and in_subtree(vote_root, root):
+                total += balances[vi]
+        return total
+
+    head = justified_root
+    while children.get(head):
+        head = max(children[head], key=lambda r: (weight(r), r))
+    return head
+
+
+def gen_fork_choice() -> None:
+    balances = [32_000_000_000] * 8
+
+    def blk(root: str, parent: str, slot: int) -> dict:
+        return {"root": root, "parent": parent, "slot": slot}
+
+    anchor = blk("0x" + "aa" * 32, "0x" + "00" * 32, 0)
+
+    def scenario(name: str, steps_in: list) -> None:
+        """Run the naive ghost alongside the step list, expanding
+        {checks: True} placeholders into concrete expected heads."""
+        blocks = {anchor["root"]: anchor}
+        votes: dict[int, str] = {}
+        pending: list[dict] = []
+        tick = 0
+        steps_out = []
+        for step in steps_in:
+            if "tick" in step:
+                tick = step["tick"]
+                for a in [a for a in pending if a["slot"] < tick]:
+                    for vi in a["indices"]:
+                        votes[vi] = a["root"]
+                pending = [a for a in pending if a["slot"] >= tick]
+                steps_out.append(step)
+            elif "block" in step:
+                b = step["block"]
+                blocks[b["root"]] = b
+                steps_out.append(step)
+            elif "attestation" in step:
+                a = step["attestation"]
+                if a["slot"] < tick:
+                    for vi in a["indices"]:
+                        votes[vi] = a["root"]
+                else:
+                    pending.append(a)
+                steps_out.append(step)
+            elif step.get("checks"):
+                head = _naive_ghost(blocks, votes, balances, anchor["root"])
+                steps_out.append({"checks": {"head": head}})
+        _write_case("fork_choice", "get_head", name, {
+            "steps.yaml": steps_out,
+            "balances.yaml": list(map(int, balances)),
+            "anchor.yaml": anchor,
+        })
+
+    A, B, C, D = ("0x" + c * 32 for c in ("1b", "2c", "3d", "4e"))
+
+    # two-branch tree: majority votes win; late votes reorg the head
+    scenario("reorg_on_late_votes", [
+        {"tick": 1},
+        {"block": blk(A, anchor["root"], 1)},
+        {"checks": True},
+        {"tick": 2},
+        {"block": blk(B, anchor["root"], 2)},
+        {"attestation": {"indices": [0, 1, 2], "root": A, "target_epoch": 0, "slot": 2}},
+        {"tick": 3},
+        {"checks": True},  # A leads 3 votes to 0
+        {"attestation": {"indices": [3, 4, 5, 6], "root": B, "target_epoch": 0, "slot": 3}},
+        {"tick": 4},
+        {"checks": True},  # B overtakes with 4 votes
+    ])
+
+    # chain extension: children inherit subtree weight
+    scenario("deep_chain_inherits_weight", [
+        {"tick": 1},
+        {"block": blk(A, anchor["root"], 1)},
+        {"block": blk(B, A, 1)},
+        {"block": blk(C, anchor["root"], 1)},
+        {"attestation": {"indices": [0, 1], "root": A, "target_epoch": 0, "slot": 1}},
+        {"attestation": {"indices": [2], "root": C, "target_epoch": 0, "slot": 1}},
+        {"tick": 2},
+        {"checks": True},  # A-subtree (2) beats C (1); head descends to B
+        {"block": blk(D, B, 2)},
+        {"tick": 3},
+        {"checks": True},  # head follows to D
+    ])
+
+    # future-slot attestations only count after their slot passes
+    scenario("queued_votes_apply_on_tick", [
+        {"tick": 1},
+        {"block": blk(A, anchor["root"], 1)},
+        {"block": blk(B, anchor["root"], 1)},
+        {"attestation": {"indices": [0], "root": A, "target_epoch": 0, "slot": 1}},
+        {"attestation": {"indices": [1, 2], "root": B, "target_epoch": 0, "slot": 5}},
+        {"tick": 2},
+        {"checks": True},  # only A's vote is live
+        {"tick": 6},
+        {"checks": True},  # queued B votes are live now: B wins
+    ])
+
+
+# --- multi-fork STF pins ------------------------------------------------------
+#
+# altair..deneb sanity vectors. These are produced by the PRODUCTION STF
+# (the naive second implementation is phase0-scope), so they are
+# regression pins + layout proof for the post-phase0 executors — clearly
+# labeled as such, unlike the naive-certified phase0 tree above.
+
+
+def _fork_root(fork: str) -> str:
+    return os.path.join(HERE, "vectors", "tests", "minimal", fork)
+
+
+def _write_fork_case(fork: str, runner: str, handler: str, case: str, files: dict) -> None:
+    d = os.path.join(_fork_root(fork), runner, handler, "pyspec_tests", case)
+    os.makedirs(d, exist_ok=True)
+    for name, payload in files.items():
+        path = os.path.join(d, name)
+        if name.endswith(".ssz"):
+            with open(path, "wb") as f:
+                f.write(payload)
+        else:
+            with open(path, "w") as f:
+                yaml.safe_dump(payload, f, sort_keys=False)
+
+
+def gen_multifork() -> None:
+    from lodestar_tpu.config import minimal_chain_config
+    from lodestar_tpu.state_transition import process_slots, state_transition
+    from lodestar_tpu.state_transition.altair import upgrade_to_altair
+    from lodestar_tpu.state_transition.bellatrix import upgrade_to_bellatrix
+    from lodestar_tpu.state_transition.capella import upgrade_to_capella
+    from lodestar_tpu.state_transition.deneb import upgrade_to_deneb
+    from lodestar_tpu.state_transition.genesis import (
+        create_interop_genesis_state,
+        interop_secret_keys,
+    )
+
+    sys.path.insert(0, os.path.join(HERE, "..", "state_transition"))
+    from test_altair import _altair_block  # the full-verification builder
+
+    far = 2**64 - 1
+    cfg = minimal_chain_config().replace(
+        ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=far, CAPELLA_FORK_EPOCH=far,
+        DENEB_FORK_EPOCH=far,
+    )
+    sks = interop_secret_keys(16)
+    genesis = upgrade_to_altair(
+        create_interop_genesis_state(
+            16, p=P, genesis_fork_version=cfg.GENESIS_FORK_VERSION
+        ),
+        cfg, P,
+    )
+
+    # altair sanity/blocks: two full blocks with sync aggregates
+    state = genesis.copy()
+    pre = state.copy()
+    blocks = []
+    for slot in (1, 2):
+        signed = _altair_block(state, slot, sks, P, cfg)
+        state = state_transition(state, signed, P, cfg)
+        blocks.append(signed)
+    files = {
+        "pre.ssz": pre.type.serialize(pre),
+        "meta.yaml": {"blocks_count": len(blocks)},
+        "post.ssz": state.type.serialize(state),
+    }
+    for i, b in enumerate(blocks):
+        files[f"blocks_{i}.ssz"] = T.altair.SignedBeaconBlock.serialize(b)
+    _write_fork_case("altair", "sanity", "blocks", "two_sync_committee_blocks", files)
+
+    # per-fork sanity/slots across an epoch boundary (epoch machinery pin)
+    upgrades = {
+        "altair": lambda s: s,
+        "bellatrix": lambda s: upgrade_to_bellatrix(s, cfg, P),
+        "capella": lambda s: upgrade_to_capella(
+            upgrade_to_bellatrix(s, cfg, P), cfg, P
+        ),
+        "deneb": lambda s: upgrade_to_deneb(
+            upgrade_to_capella(upgrade_to_bellatrix(s, cfg, P), cfg, P), cfg, P
+        ),
+    }
+    for fork, up in upgrades.items():
+        state = up(genesis.copy())
+        pre = state.copy()
+        slots = P.SLOTS_PER_EPOCH + 1  # crosses one epoch boundary
+        process_slots(state, int(pre.slot) + slots, P)
+        _write_fork_case(fork, "sanity", "slots", "epoch_boundary", {
+            "pre.ssz": pre.type.serialize(pre),
+            "slots.yaml": slots,
+            "post.ssz": state.type.serialize(state),
+        })
+
+
+def main() -> None:
+    for runner in ("shuffling", "rewards", "ssz_static", "fork_choice"):
+        shutil.rmtree(os.path.join(ROOT, runner), ignore_errors=True)
+    for fork in ("altair", "bellatrix", "capella", "deneb"):
+        shutil.rmtree(_fork_root(fork), ignore_errors=True)
+    gen_shuffling()
+    gen_rewards()
+    gen_ssz_static()
+    gen_fork_choice()
+    gen_multifork()
+    n = sum(len(files) for _, _, files in os.walk(ROOT))
+    print(f"fixture tree now holds {n} files under {ROOT}")
+
+
+if __name__ == "__main__":
+    main()

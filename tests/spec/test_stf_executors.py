@@ -38,17 +38,19 @@ def _t():
     return ssz_types()
 
 
+def _ns(case):
+    """Fork namespace for a case (vectors exist phase0..deneb)."""
+    return getattr(_t(), case.fork)
+
+
 def _load_state(case, stem):
-    t = _t()
-    return t.phase0.BeaconState.deserialize(case.load(stem))
+    return _ns(case).BeaconState.deserialize(case.load(stem))
 
 
 def _expect_post(case, post_state) -> None:
-    t = _t()
-    got = t.phase0.BeaconState.hash_tree_root(post_state)
-    want = t.phase0.BeaconState.hash_tree_root(
-        t.phase0.BeaconState.deserialize(case.load("post"))
-    )
+    typ = _ns(case).BeaconState
+    got = typ.hash_tree_root(post_state)
+    want = typ.hash_tree_root(typ.deserialize(case.load("post")))
     assert got == want, f"{case.test_id}: post-state root mismatch"
 
 
@@ -144,13 +146,12 @@ def _sanity_slots(case):
 
 
 def _blocks_handler(case):
-    t = _t()
     state = _load_state(case, "pre")
     meta = case.load("meta")
     has_post = "post.ssz" in case.files()
     try:
         for i in range(int(meta["blocks_count"])):
-            signed = t.phase0.SignedBeaconBlock.deserialize(case.load(f"blocks_{i}"))
+            signed = _ns(case).SignedBeaconBlock.deserialize(case.load(f"blocks_{i}"))
             state = state_transition(state, signed, verify_signatures=True)
     except Exception:
         assert not has_post, f"{case.test_id}: valid case raised"
@@ -159,18 +160,130 @@ def _blocks_handler(case):
     _expect_post(case, state)
 
 
+def _shuffling_handler(case):
+    import numpy as np
+
+    from lodestar_tpu.state_transition.shuffle import (
+        compute_shuffled_index,
+        shuffle_list,
+    )
+
+    m = case.load("mapping")
+    seed = bytes.fromhex(m["seed"][2:])
+    count = int(m["count"])
+    mapping = [int(x) for x in m["mapping"]]
+    got = [compute_shuffled_index(i, count, seed) for i in range(count)]
+    assert got == mapping, f"{case.test_id}: shuffled-index mismatch"
+    # shuffle_list is the inverse-direction list permutation
+    inverse = [0] * count
+    for i, j in enumerate(mapping):
+        inverse[j] = i
+    assert list(map(int, shuffle_list(np.arange(count), seed))) == inverse, (
+        f"{case.test_id}: shuffle_list mismatch"
+    )
+
+
+def _rewards_handler(case):
+    from lodestar_tpu.state_transition import epoch as E
+
+    pre = _load_state(case, "pre")
+    deltas = case.load("deltas")
+    want_rewards = [0] * len(pre.validators)
+    want_penalties = [0] * len(pre.validators)
+    for comp in deltas.values():
+        for i, r in enumerate(comp["rewards"]):
+            want_rewards[i] += int(r)
+        for i, p in enumerate(comp["penalties"]):
+            want_penalties[i] += int(p)
+    ctx = EpochContext(pre)
+    ep = E.before_process_epoch(pre, ctx)
+    rewards, penalties = E.get_attestation_deltas(pre, ep)
+    assert list(map(int, rewards)) == want_rewards, f"{case.test_id}: rewards"
+    assert list(map(int, penalties)) == want_penalties, f"{case.test_id}: penalties"
+
+
+def _ssz_static_handler(case):
+    t = _t()
+    typ = (
+        getattr(t.phase0, case.handler)
+        if case.handler in ("BeaconBlock", "BeaconState")
+        else getattr(t, case.handler)
+    )
+    data = case.load("serialized")
+    value = typ.deserialize(data)
+    root = bytes.fromhex(case.load("roots")["root"][2:])
+    assert typ.hash_tree_root(value) == root, f"{case.test_id}: root mismatch"
+    assert typ.serialize(value) == data, f"{case.test_id}: reserialize mismatch"
+
+
+def _fork_choice_handler(case):
+    import numpy as np
+
+    from lodestar_tpu.fork_choice import ForkChoice
+    from lodestar_tpu.fork_choice.proto_array import HEX_ZERO_HASH, ProtoBlock
+
+    anchor = case.load("anchor")
+    balances = np.asarray([int(b) for b in case.load("balances")], dtype=np.int64)
+    p = _t().phase0.BeaconState  # preset via params; slots_per_epoch below
+    from lodestar_tpu import params as _params
+
+    spe = _params.active_preset().SLOTS_PER_EPOCH
+
+    def proto(b):
+        return ProtoBlock(
+            slot=int(b["slot"]),
+            block_root=b["root"],
+            parent_root=b["parent"],
+            state_root=HEX_ZERO_HASH,
+            target_root=b["root"],
+            justified_epoch=0,
+            justified_root=anchor["root"],
+            finalized_epoch=0,
+            finalized_root=anchor["root"],
+        )
+
+    fc = ForkChoice.from_anchor(
+        proto(anchor), current_slot=0, justified_balances=balances, slots_per_epoch=spe
+    )
+    for step in case.load("steps"):
+        if "tick" in step:
+            fc.on_tick(int(step["tick"]))
+        elif "block" in step:
+            fc.on_block(proto(step["block"]))
+        elif "attestation" in step:
+            a = step["attestation"]
+            fc.on_attestation(
+                [int(i) for i in a["indices"]], a["root"], int(a["target_epoch"]), int(a["slot"])
+            )
+        elif "checks" in step:
+            head = fc.update_head()
+            assert head == step["checks"]["head"], (
+                f"{case.test_id}: head {head} != {step['checks']['head']}"
+            )
+
+
 def test_stf_spec_vectors_exhaustive():
     """Every runner/handler in the tree must be claimed (unknown =>
     KeyError), and every case must pass its executor."""
+    from generate_more_vectors import SSZ_STATIC_TYPES
     from test_bls_vectors import RUNNERS as BLS_RUNNERS  # the existing BLS table
 
+    ssz_static_handlers = {
+        name: _ssz_static_handler
+        for name in SSZ_STATIC_TYPES + ["BeaconBlock", "BeaconState"]
+    }
     runners = {
         "bls": BLS_RUNNERS["bls"],
         "operations": _ops_runners(),
         "epoch_processing": {name: _epoch_handler(name) for name in EPOCH_PIPELINE},
         "sanity": {"slots": _sanity_slots, "blocks": _blocks_handler},
         "finality": {"finality": _blocks_handler},
+        "shuffling": {"core": _shuffling_handler},
+        "rewards": {"basic": _rewards_handler},
+        "ssz_static": ssz_static_handlers,
+        "fork_choice": {"get_head": _fork_choice_handler},
     }
     n = run_spec_tests(VECTORS, runners, SkipOpts())
-    # operations(12) + epoch_processing(10) + sanity(3) + finality(1) + bls(28)
-    assert n >= 50, f"expected the full fixture tree to run, got {n} cases"
+    # operations(12) + epoch_processing(10) + sanity(3) + finality(1) +
+    # bls(28) + shuffling(5) + rewards(1) + ssz_static(38) + fork_choice(3)
+    assert n >= 95, f"expected the full fixture tree to run, got {n} cases"
