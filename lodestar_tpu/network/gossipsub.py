@@ -187,6 +187,8 @@ class GossipParams:
     MCACHE_LEN = 6  # windows kept for IWANT service
     MCACHE_GOSSIP = 3  # windows advertised in IHAVE
     SEEN_TTL_SEC = 385.0  # SLOTS_PER_EPOCH * SECONDS_PER_SLOT on mainnet
+    RETAIN_SCORE_SEC = 3600.0  # hour-scale (reference retainScore): a
+    # penalized peer must not wash its score by briefly disconnecting
     PRUNE_BACKOFF_SEC = 60
     # score thresholds (scoringParameters.ts gossipThreshold etc.)
     GOSSIP_THRESHOLD = -4000.0
@@ -422,11 +424,9 @@ class GossipSub:
     # -- peer/stream plumbing --------------------------------------------------
 
     async def _on_peer(self, peer_id: str) -> None:
-        sc = self.scores.get(peer_id)
-        if sc is not None:
-            sc.disconnected_at = None
         """New connection: open our outbound RPC stream, announce subs."""
-        self.scores.setdefault(peer_id, _PeerScore())
+        sc = self.scores.setdefault(peer_id, _PeerScore())
+        sc.disconnected_at = None
         try:
             stream = await self.host.new_stream(peer_id, PROTOCOL_ID)
         except Exception as e:
@@ -549,22 +549,28 @@ class GossipSub:
     async def _on_message(self, peer_id: str, topic: str, raw: bytes) -> None:
         msg_id = compute_message_id(raw)
         now = self.now()
-        first_seen = self.seen.get(msg_id)
-        if first_seen is not None:
+        first = self.seen.get(msg_id)
+        if first is not None:
+            first_time, first_topic, first_accepted = first
             self.metrics["duplicates"] += 1
             # P3 counts near-duplicate deliveries from mesh peers: a peer
             # forwarding within the delivery window is doing its mesh job
-            # even when another peer was first (gossipsub v1.1 spec)
+            # even when another peer was first (gossipsub v1.1 spec).
+            # Credit requires the first delivery to have VALIDATED on the
+            # SAME topic — else colluders could farm credit by echoing
+            # junk or replaying ids across topics.
             tp = self._params_for(topic)
             if (
-                topic in self.topics
+                first_accepted
+                and first_topic == topic
+                and topic in self.topics
                 and peer_id in self.mesh.get(topic, set())
-                and now - first_seen <= tp.mesh_deliveries_window_sec
+                and now - first_time <= tp.mesh_deliveries_window_sec
             ):
                 ts = self.scores.setdefault(peer_id, _PeerScore()).topic(topic)
                 ts.mesh_deliveries = min(ts.mesh_deliveries + 1.0, tp.mesh_deliveries_cap)
             return
-        self.seen[msg_id] = now
+        self.seen[msg_id] = (now, topic, False)
         verdict = "accept"
         ssz = raw
         if self._validator is not None:
@@ -582,6 +588,7 @@ class GossipSub:
             return
         if verdict == "ignore":
             return
+        self.seen[msg_id] = (now, topic, True)  # validated first delivery
         sc = self.scores.setdefault(peer_id, _PeerScore())
         ts = sc.topic(topic)
         tp = self._params_for(topic)
@@ -649,7 +656,7 @@ class GossipSub:
         msg_id = compute_message_id(raw)
         if msg_id in self.seen:
             return 0
-        self.seen[msg_id] = self.now()
+        self.seen[msg_id] = (self.now(), topic, True)
         self._mcache_put(msg_id, topic, raw)
         return await self._forward(topic, raw, exclude=set(), flood=True)
 
@@ -745,9 +752,10 @@ class GossipSub:
         # decay scores, expire seen + backoff
         for sc in self.scores.values():
             sc.decay(self.p, self._params_for)
-        # evict decayed score state of disconnected peers (reference
-        # retainScore): bounds memory against peer-id churn
-        retain = self.p.SEEN_TTL_SEC
+        # evict score state of long-disconnected peers (reference
+        # retainScore): bounds memory against peer-id churn without
+        # letting graylisted peers reset via quick reconnects
+        retain = self.p.RETAIN_SCORE_SEC
         for pid in list(self.scores):
             sc = self.scores[pid]
             if (
@@ -757,5 +765,5 @@ class GossipSub:
             ):
                 del self.scores[pid]
         cutoff = now - self.p.SEEN_TTL_SEC
-        self.seen = {k: v for k, v in self.seen.items() if v >= cutoff}
+        self.seen = {k: v for k, v in self.seen.items() if v[0] >= cutoff}
         self.backoff = {k: v for k, v in self.backoff.items() if v > now}

@@ -109,7 +109,11 @@ class BlsOffloadClient(IBlsVerifier):
                 time.sleep(delay)
                 if self._closed:
                     return
-                self._reconnect()
+                # never tear down a channel with verifications in flight:
+                # a transient probe timeout must not abort valid work —
+                # in-flight RPCs fail (or succeed) on their own merits
+                if self._outstanding == 0:
+                    self._reconnect()
 
     # -- IBlsVerifier ----------------------------------------------------------
 
