@@ -105,9 +105,6 @@ class NetworkMetrics:
     gossip_mesh_peers: Gauge
     gossip_received: Counter
     gossip_duplicates: Counter
-    reqresp_requests_sent: Counter
-    reqresp_requests_received: Counter
-    reqresp_errors: Counter
 
 
 @dataclass
@@ -153,6 +150,113 @@ class ApiMetrics:
 
 
 @dataclass
+class ReqRespMetrics:
+    """beacon_reqresp_* detail (reference metrics/lodestar.ts reqresp
+    family): per-protocol streams, bytes, timing and rate limiting."""
+
+    requests_sent: Counter
+    requests_received: Counter
+    request_errors: Counter
+    response_time: Histogram
+    response_chunks_sent: Counter
+    response_chunks_received: Counter
+    rate_limited: Counter
+    dial_timeouts: Counter
+    streams_reset: Counter
+
+
+@dataclass
+class PeerMetrics:
+    """lodestar_peers_* detail (reference peerManager metrics)."""
+
+    peer_count: Gauge
+    peers_by_client: Gauge
+    peer_score: Histogram
+    peer_action_count: Counter
+    goodbye_sent: Counter
+    goodbye_received: Counter
+    dials_attempted: Counter
+    dials_succeeded: Counter
+    long_lived_subnets: Gauge
+    discv5_sessions: Gauge
+    discv5_findnode_sent: Counter
+    discv5_enrs_discovered: Counter
+
+
+@dataclass
+class GossipDetailMetrics:
+    """gossipsub router internals (reference gossipsub metrics)."""
+
+    mesh_grafts: Counter
+    mesh_prunes: Counter
+    ihave_sent: Counter
+    iwant_received: Counter
+    iwant_served: Counter
+    mcache_size: Gauge
+    peer_score_by_topic: Gauge
+    flood_publishes: Counter
+    backoff_violations: Counter
+
+
+@dataclass
+class SyncDetailMetrics:
+    """lodestar_sync_* detail (reference sync metrics)."""
+
+    status: Gauge
+    peers_by_status: Gauge
+    batch_download_time: Histogram
+    batch_processing_time: Histogram
+    batches_downloaded: Counter
+    batch_download_retries: Counter
+    head_distance: Gauge
+    backfill_earliest_slot: Gauge
+    unknown_block_queue_length: Gauge
+
+
+@dataclass
+class DbDetailMetrics:
+    read_items: Counter
+    write_items: Counter
+    batch_write_time: Histogram
+    wal_size_bytes: Gauge
+    archived_states: Counter
+    archived_blocks: Counter
+    pruned_blocks: Counter
+
+
+@dataclass
+class ChainDetailMetrics:
+    """block pipeline + caches (reference chain metrics)."""
+
+    block_import_time: Histogram
+    block_production_time: Histogram
+    blocks_imported: Counter
+    blocks_rejected: Counter
+    attestations_imported: Counter
+    seen_attesters_size: Gauge
+    seen_aggregators_size: Gauge
+    checkpoint_state_cache_size: Gauge
+    state_cache_size: Gauge
+    light_client_updates_served: Counter
+    light_client_bootstraps_served: Counter
+    eth1_block_height: Gauge
+    eth1_deposits_fetched: Counter
+    eth1_requests: Counter
+    engine_api_requests: Counter
+    engine_api_time: Histogram
+    builder_requests: Counter
+    builder_circuit_open: Gauge
+
+
+@dataclass
+class ProcessMetrics:
+    event_loop_lag: Histogram
+    start_time: Gauge
+    offload_outstanding: Gauge
+    offload_healthy: Gauge
+
+
+@dataclass
 class BeaconMetrics:
     creator: RegistryMetricCreator
     bls_pool: BlsPoolMetrics
@@ -165,6 +269,13 @@ class BeaconMetrics:
     regen: "RegenMetrics"
     op_pool: "OpPoolMetrics"
     api: "ApiMetrics"
+    reqresp: "ReqRespMetrics"
+    peer: "PeerMetrics"
+    gossip_detail: "GossipDetailMetrics"
+    sync_detail: "SyncDetailMetrics"
+    db_detail: "DbDetailMetrics"
+    chain: "ChainDetailMetrics"
+    process: "ProcessMetrics"
     head_slot: Gauge
     finalized_epoch: Gauge
     justified_epoch: Gauge
@@ -265,15 +376,6 @@ def create_metrics() -> BeaconMetrics:
         gossip_duplicates=c.counter(
             "lodestar_gossipsub_seen_cache_duplicates_total", "Duplicate gossip messages"
         ),
-        reqresp_requests_sent=c.counter(
-            "beacon_reqresp_outgoing_requests_total", "Outgoing reqresp requests", ["method"]
-        ),
-        reqresp_requests_received=c.counter(
-            "beacon_reqresp_incoming_requests_total", "Incoming reqresp requests", ["method"]
-        ),
-        reqresp_errors=c.counter(
-            "beacon_reqresp_outgoing_errors_total", "Reqresp errors", ["method"]
-        ),
     )
     sync = SyncMetrics(
         range_sync_batches=c.counter(
@@ -339,6 +441,158 @@ def create_metrics() -> BeaconMetrics:
             "lodestar_api_rest_response_time_seconds", "REST response time", _SEC_SMALL
         ),
     )
+    reqresp = ReqRespMetrics(
+        requests_sent=c.counter(
+            "beacon_reqresp_outgoing_requests_total", "Outgoing requests", ["protocol"]
+        ),
+        requests_received=c.counter(
+            "beacon_reqresp_incoming_requests_total", "Incoming requests", ["protocol"]
+        ),
+        request_errors=c.counter(
+            "beacon_reqresp_incoming_errors_total", "Incoming request errors", ["protocol"]
+        ),
+        response_time=c.histogram(
+            "beacon_reqresp_response_time_seconds", "Full response time", _SEC_SMALL, ["protocol"]
+        ),
+        response_chunks_sent=c.counter(
+            "beacon_reqresp_outgoing_response_chunks_total", "Response chunks sent", ["protocol"]
+        ),
+        response_chunks_received=c.counter(
+            "beacon_reqresp_incoming_response_chunks_total", "Response chunks received", ["protocol"]
+        ),
+        rate_limited=c.counter(
+            "beacon_reqresp_rate_limited_total", "Rate-limited requests", ["protocol"]
+        ),
+        dial_timeouts=c.counter("beacon_reqresp_dial_timeouts_total", "Dial timeouts"),
+        streams_reset=c.counter("beacon_reqresp_streams_reset_total", "Streams reset"),
+    )
+    peer = PeerMetrics(
+        peer_count=c.gauge("lodestar_peers_count", "Connected peer count"),
+        peers_by_client=c.gauge("lodestar_peers_by_client_count", "Peers by client", ["client"]),
+        peer_score=c.histogram(
+            "lodestar_app_peer_score", "Application peer scores", (-100, -50, -10, 0, 10, 50, 100)
+        ),
+        peer_action_count=c.counter(
+            "lodestar_peers_report_peer_count_total", "Peer score actions", ["action"]
+        ),
+        goodbye_sent=c.counter("lodestar_peer_goodbye_sent_total", "Goodbyes sent", ["reason"]),
+        goodbye_received=c.counter(
+            "lodestar_peer_goodbye_received_total", "Goodbyes received", ["reason"]
+        ),
+        dials_attempted=c.counter("lodestar_peers_dial_attempts_total", "Dial attempts"),
+        dials_succeeded=c.counter("lodestar_peers_dial_success_total", "Successful dials"),
+        long_lived_subnets=c.gauge(
+            "lodestar_peers_long_lived_attnets_count", "Long-lived attnet subscriptions"
+        ),
+        discv5_sessions=c.gauge("lodestar_discv5_active_sessions_count", "discv5 sessions"),
+        discv5_findnode_sent=c.counter(
+            "lodestar_discv5_findnode_sent_total", "FINDNODE queries sent"
+        ),
+        discv5_enrs_discovered=c.counter(
+            "lodestar_discv5_discovered_enrs_total", "ENRs discovered"
+        ),
+    )
+    gossip_detail = GossipDetailMetrics(
+        mesh_grafts=c.counter("lodestar_gossip_mesh_graft_total", "Mesh grafts", ["topic"]),
+        mesh_prunes=c.counter("lodestar_gossip_mesh_prune_total", "Mesh prunes", ["topic"]),
+        ihave_sent=c.counter("lodestar_gossip_ihave_sent_total", "IHAVE control messages sent"),
+        iwant_received=c.counter("lodestar_gossip_iwant_received_total", "IWANT requests received"),
+        iwant_served=c.counter("lodestar_gossip_iwant_served_total", "IWANT messages served"),
+        mcache_size=c.gauge("lodestar_gossip_mcache_size", "Message cache entries"),
+        peer_score_by_topic=c.gauge(
+            "lodestar_gossip_score_by_topic", "Mean peer score per topic", ["topic"]
+        ),
+        flood_publishes=c.counter("lodestar_gossip_flood_publish_total", "Flood publishes"),
+        backoff_violations=c.counter(
+            "lodestar_gossip_graft_backoff_violations_total", "Grafts inside backoff"
+        ),
+    )
+    sync_detail = SyncDetailMetrics(
+        status=c.gauge("lodestar_sync_status", "0=stalled 1=syncing 2=synced"),
+        peers_by_status=c.gauge(
+            "lodestar_sync_peers_by_status_count", "Peers by sync usefulness", ["status"]
+        ),
+        batch_download_time=c.histogram(
+            "lodestar_sync_range_batch_download_seconds", "Batch download time", _SEC_SMALL
+        ),
+        batch_processing_time=c.histogram(
+            "lodestar_sync_range_batch_processing_seconds", "Batch processing time", _SEC_SMALL
+        ),
+        batches_downloaded=c.counter(
+            "lodestar_sync_range_batches_downloaded_total", "Batches downloaded"
+        ),
+        batch_download_retries=c.counter(
+            "lodestar_sync_range_download_retries_total", "Batch download retries"
+        ),
+        head_distance=c.gauge("lodestar_sync_head_distance_slots", "Slots behind the clock"),
+        backfill_earliest_slot=c.gauge(
+            "lodestar_backfill_earliest_slot", "Earliest backfilled slot"
+        ),
+        unknown_block_queue_length=c.gauge(
+            "lodestar_sync_unknown_block_pending_count", "Pending unknown-block roots"
+        ),
+    )
+    db_detail = DbDetailMetrics(
+        read_items=c.counter("lodestar_db_read_items_total", "Items read", ["bucket"]),
+        write_items=c.counter("lodestar_db_write_items_total", "Items written", ["bucket"]),
+        batch_write_time=c.histogram(
+            "lodestar_db_batch_write_seconds", "Batch write latency", _SEC_TINY
+        ),
+        wal_size_bytes=c.gauge("lodestar_db_wal_size_bytes", "Write-ahead log size"),
+        archived_states=c.counter("lodestar_db_archived_states_total", "States archived"),
+        archived_blocks=c.counter("lodestar_db_archived_blocks_total", "Blocks archived"),
+        pruned_blocks=c.counter("lodestar_db_pruned_blocks_total", "Hot blocks pruned"),
+    )
+    chain = ChainDetailMetrics(
+        block_import_time=c.histogram(
+            "lodestar_block_processor_import_seconds", "Full block import time", _SEC_SMALL
+        ),
+        block_production_time=c.histogram(
+            "lodestar_block_production_seconds", "Block production time", _SEC_SMALL
+        ),
+        blocks_imported=c.counter("lodestar_blocks_imported_total", "Blocks imported", ["source"]),
+        blocks_rejected=c.counter("lodestar_blocks_rejected_total", "Blocks rejected", ["reason"]),
+        attestations_imported=c.counter(
+            "lodestar_attestations_imported_total", "Attestations applied to fork choice"
+        ),
+        seen_attesters_size=c.gauge("lodestar_seen_cache_attesters_size", "Seen attesters"),
+        seen_aggregators_size=c.gauge("lodestar_seen_cache_aggregators_size", "Seen aggregators"),
+        checkpoint_state_cache_size=c.gauge(
+            "lodestar_cp_state_cache_size", "Checkpoint state cache entries"
+        ),
+        state_cache_size=c.gauge("lodestar_state_cache_size", "Hot state cache entries"),
+        light_client_updates_served=c.counter(
+            "lodestar_light_client_updates_served_total", "LC updates served"
+        ),
+        light_client_bootstraps_served=c.counter(
+            "lodestar_light_client_bootstraps_served_total", "LC bootstraps served"
+        ),
+        eth1_block_height=c.gauge("lodestar_eth1_latest_block_number", "Latest eth1 block seen"),
+        eth1_deposits_fetched=c.counter("lodestar_eth1_deposit_events_total", "Deposit logs fetched"),
+        eth1_requests=c.counter("lodestar_eth1_requests_total", "Eth1 JSON-RPC requests", ["method"]),
+        engine_api_requests=c.counter(
+            "lodestar_execution_engine_requests_total", "Engine API requests", ["method"]
+        ),
+        engine_api_time=c.histogram(
+            "lodestar_execution_engine_request_seconds", "Engine API latency", _SEC_SMALL
+        ),
+        builder_requests=c.counter(
+            "lodestar_builder_requests_total", "Builder API requests", ["method", "status"]
+        ),
+        builder_circuit_open=c.gauge(
+            "lodestar_builder_circuit_breaker_open", "Builder circuit breaker state"
+        ),
+    )
+    process = ProcessMetrics(
+        event_loop_lag=c.histogram(
+            "lodestar_event_loop_lag_seconds", "Event loop scheduling lag", _SEC_TINY
+        ),
+        start_time=c.gauge("process_start_time_seconds", "Process start unix time"),
+        offload_outstanding=c.gauge(
+            "lodestar_offload_outstanding_jobs", "Offload jobs in flight"
+        ),
+        offload_healthy=c.gauge("lodestar_offload_healthy", "Offload channel health bit"),
+    )
     return BeaconMetrics(
         creator=c,
         bls_pool=bls,
@@ -351,6 +605,13 @@ def create_metrics() -> BeaconMetrics:
         regen=regen,
         op_pool=op_pool,
         api=api,
+        reqresp=reqresp,
+        peer=peer,
+        gossip_detail=gossip_detail,
+        sync_detail=sync_detail,
+        db_detail=db_detail,
+        chain=chain,
+        process=process,
         head_slot=c.gauge("beacon_head_slot", "Current head slot"),
         finalized_epoch=c.gauge("beacon_finalized_epoch", "Finalized epoch"),
         justified_epoch=c.gauge("beacon_current_justified_epoch", "Justified epoch"),

@@ -149,37 +149,34 @@ def prepare_sets(sets: list[SignatureSet]):
     )
 
 
-@jax.jit
-def _device_batch_verify_impl(pk_x, pk_y, h_x, h_y, sig_x, sig_y, coeff_bits, mask):
+def _blind_and_aggregate_body(pk_x, pk_y, sig_x, sig_y, coeff_bits, mask):
+    """Blinded scalar muls (r_i*PK_i in G1, r_i*S_i in G2), the masked G2
+    fold to the aggregate signature, affine conversions."""
     one1 = fp.one_mont()
     one2 = tw.fp2_one()
-
-    # blinded scalar multiples (Jacobian): r_i * PK_i in G1, r_i * S_i in G2
     rpk = cv.scalar_mul_var(cv.F1, (pk_x, pk_y), coeff_bits, one1)
     rsig = cv.scalar_mul_var(cv.F2, (sig_x, sig_y), coeff_bits, one2)
-
     # padded entries must not contribute to the signature aggregate:
     # force their blinded sig to infinity before the fold
     mcol = mask[:, None, None]
     rsig = (rsig[0], rsig[1], jnp.where(mcol, rsig[2], jnp.zeros_like(rsig[2])))
     s_agg = cv.fold_sum(cv.F2, rsig)
-
-    # to affine for the Miller loop (batched Fermat chains)
     rpk_aff = cv.jac_to_affine_batch(cv.F1, rpk)
     s_aff = cv.jac_to_affine_batch(cv.F2, tuple(c[None] for c in s_agg))
     s_inf = cv.jac_is_inf(cv.F2, s_agg)
+    return rpk_aff, s_aff, s_inf
 
-    # Miller batch: N blinded-pubkey/message pairs + the (-g1, S_agg) pair.
-    # Padded pair entries get the generator pair as a placeholder (any
-    # valid non-infinity point works; the mask drops their Miller value).
+
+def _assemble_pairs(rpk_aff, s_aff, s_inf, h_x, h_y, mask):
+    """Miller batch: N blinded-pubkey/message pairs + the (-g1, S_agg)
+    pair. Padded / infinite entries get the generator pair as a
+    placeholder (any valid non-infinity point works; the mask drops
+    their Miller value)."""
     p_x = jnp.concatenate([rpk_aff[0], _NEG_G1_X[None].astype(jnp.int32)], axis=0)
     p_y = jnp.concatenate([rpk_aff[1], _NEG_G1_Y[None].astype(jnp.int32)], axis=0)
     q_x = jnp.concatenate([h_x, s_aff[0]], axis=0)
     q_y = jnp.concatenate([h_y, s_aff[1]], axis=0)
     pair_mask = jnp.concatenate([mask, ~s_inf[None]], axis=0)
-
-    # padded / infinite entries: substitute the generator pair so the
-    # Miller loop runs on valid curve points, then mask the result
     gen_p = (jnp.asarray(_NEG_G1_X), jnp.asarray(_NEG_G1_Y))
     gen_q_x = jnp.broadcast_to(h_x[0], q_x.shape[1:])
     gen_q_y = jnp.broadcast_to(h_y[0], q_y.shape[1:])
@@ -188,19 +185,65 @@ def _device_batch_verify_impl(pk_x, pk_y, h_x, h_y, sig_x, sig_y, coeff_bits, ma
     p_y = jnp.where(mm[..., 0], p_y, gen_p[1])
     q_x = jnp.where(mm, q_x, gen_q_x)
     q_y = jnp.where(mm, q_y, gen_q_y)
+    return p_x, p_y, q_x, q_y, pair_mask
 
-    fs = prg.miller_loop((p_x, p_y), (q_x, q_y))
+
+def _fold_verdict_body(fs, pair_mask):
     f = prg.fp12_product_fold(fs, mask=pair_mask)
     return tw.fp12_eq_one(prg.final_exponentiation(f))
 
 
-def device_batch_verify(pk, h, sig, coeff_bits, mask) -> jax.Array:
-    """Jitted device verification core.
+@jax.jit
+def _device_batch_verify_impl(pk_x, pk_y, h_x, h_y, sig_x, sig_y, coeff_bits, mask):
+    """Monolithic composition of the shared stage bodies (one program)."""
+    rpk_aff, s_aff, s_inf = _blind_and_aggregate_body(
+        pk_x, pk_y, sig_x, sig_y, coeff_bits, mask
+    )
+    p_x, p_y, q_x, q_y, pair_mask = _assemble_pairs(
+        rpk_aff, s_aff, s_inf, h_x, h_y, mask
+    )
+    fs = prg.miller_loop((p_x, p_y), (q_x, q_y))
+    return _fold_verdict_body(fs, pair_mask)
 
-    pk: (x, y) each (N, 32); h/sig: (x, y) each (N, 2, 32); coeff_bits:
+
+_stage_blind_and_aggregate = jax.jit(_blind_and_aggregate_body)
+_stage_miller = jax.jit(lambda p_x, p_y, q_x, q_y: prg.miller_loop((p_x, p_y), (q_x, q_y)))
+_stage_fold_verdict = jax.jit(_fold_verdict_body)
+
+
+def _device_batch_verify_staged(pk, h, sig, coeff_bits, mask):
+    """The batch-verify pipeline as THREE jitted stages instead of one
+    monolithic program. Functionally identical to
+    `_device_batch_verify_impl`; used on Pallas backends, where the
+    monolithic compile has produced wrong verdicts even though every
+    stage (and every construct) verifies in isolation — staging sidesteps
+    the whole-program miscompile at the cost of two tiny host round
+    trips. See tools/pallas_v2_proto.py provenance notes.
+    """
+    coeff_bits = jnp.asarray(coeff_bits)
+    mask = jnp.asarray(mask)
+    rpk_aff, s_aff, s_inf = _stage_blind_and_aggregate(
+        pk[0], pk[1], sig[0], sig[1], coeff_bits, mask
+    )
+    p_x, p_y, q_x, q_y, pair_mask = _assemble_pairs(
+        rpk_aff, s_aff, s_inf, jnp.asarray(h[0]), jnp.asarray(h[1]), mask
+    )
+    fs = _stage_miller(p_x, p_y, q_x, q_y)
+    return _stage_fold_verdict(fs, pair_mask)
+
+
+def device_batch_verify(pk, h, sig, coeff_bits, mask) -> jax.Array:
+    """Device verification core (see _device_batch_verify_impl /
+    _device_batch_verify_staged).
+
+    pk: (x, y) each (N, 33); h/sig: (x, y) each (N, 2, 33); coeff_bits:
     (N, 64) int32 MSB-first; mask: (N,) bool — False entries are padding.
     Returns a scalar bool array.
     """
+    from lodestar_tpu.ops import fp_pallas
+
+    if fp_pallas.use_pallas():
+        return _device_batch_verify_staged(pk, h, sig, coeff_bits, mask)
     return _device_batch_verify_impl(
         pk[0], pk[1], h[0], h[1], sig[0], sig[1],
         jnp.asarray(coeff_bits), jnp.asarray(mask),
@@ -308,20 +351,40 @@ def device_batch_verify_sharded(mesh, pk, h, sig, coeff_bits, mask) -> jax.Array
         )
     # persistent-cache serialization of SHARDED executables segfaults
     # intermittently in this jax build (observed twice in
-    # compilation_cache.put_executable_and_time), so these programs
-    # compile with the persistent cache off — and the jitted callable is
-    # memoized per (mesh, batch size) so each process compiles ONCE and
-    # repeat calls hit jax's in-memory executable cache. The flag flip
-    # is lock-guarded: a concurrent compile on another thread must not
-    # observe (or clobber) the temporary disable.
+    # compilation_cache.put_executable_and_time). r5 fix: the cache WRITE
+    # happens in a SACRIFICIAL SUBPROCESS (same program, cache enabled) —
+    # a child segfault cannot take the node down, and on child success
+    # the in-process compile below becomes a warm cache READ (loads are
+    # not the crashing path). If the child fails, fall back to compiling
+    # with the persistent cache off, exactly the r4 behavior. The jitted
+    # callable is memoized per (mesh, batch size); the flag flip is
+    # lock-guarded against concurrent compiles.
     key = (tuple(d.id for d in mesh.devices.flat), pk[0].shape[0])
     jitted = _SHARDED_JIT_CACHE.get(key)
     if jitted is None:
         with _SHARDED_COMPILE_LOCK:
             jitted = _SHARDED_JIT_CACHE.get(key)
             if jitted is None:
+                in_warmer = bool(os.environ.get("LODESTAR_IN_CACHE_WARMER"))
+                warmed = (
+                    False if in_warmer
+                    else _warm_sharded_cache_subprocess(mesh.devices.size, pk[0].shape[0])
+                )
                 prev_cache = jax.config.jax_enable_compilation_cache
-                jax.config.update("jax_enable_compilation_cache", False)
+                prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+                if not warmed and not in_warmer:
+                    # no warm entry: compile with the persistent cache OFF
+                    # (the r4 segfault workaround). Inside the warmer child
+                    # the cache stays ON — that's the sacrificial write.
+                    jax.config.update("jax_enable_compilation_cache", False)
+                elif warmed:
+                    # cache READS on, WRITES effectively off: if the
+                    # parent's key unexpectedly misses the child's entry,
+                    # it must not run the crash-prone sharded serialization
+                    # in-process (min-compile-time gate = no write ever)
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs", 1e18
+                    )
                 try:
                     jitted = jax.jit(fn)
                     # trigger compile inside the guarded window
@@ -331,12 +394,76 @@ def device_batch_verify_sharded(mesh, pk, h, sig, coeff_bits, mask) -> jax.Array
                     )
                 finally:
                     jax.config.update("jax_enable_compilation_cache", prev_cache)
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs", prev_min
+                    )
                 _SHARDED_JIT_CACHE[key] = jitted
     ok = jitted(
         pk[0], pk[1], h[0], h[1], sig[0], sig[1],
         jnp.asarray(coeff_bits), jnp.asarray(mask),
     )
     return ok.all()
+
+
+def _warm_sharded_cache_subprocess(n_devices: int, batch: int) -> bool:
+    """Compile the sharded program in a child process with the persistent
+    cache ENABLED, so the crash-prone sharded-executable serialization
+    (put_executable_and_time) runs where a segfault is harmless. Returns
+    True when the child exits cleanly (the parent will then hit the
+    cache); only meaningful on the CPU mesh (the dryrun path — the chip
+    path has no virtual mesh to rebuild in a child).
+
+    Opt-out: LODESTAR_SHARDED_CACHE_SUBPROCESS=0 restores the plain
+    disabled-cache compile. Recursion guard via LODESTAR_IN_CACHE_WARMER.
+    """
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
+
+    if _os.environ.get("LODESTAR_SHARDED_CACHE_SUBPROCESS", "1") in ("0", "false"):
+        return False
+    if _os.environ.get("LODESTAR_IN_CACHE_WARMER"):
+        return False
+    if jax.default_backend() != "cpu":
+        return False  # the segfault workaround only matters for the dryrun mesh
+    if not jax.config.jax_compilation_cache_dir:
+        # without a persistent cache dir the parent could never read the
+        # child's work: warming would just double the compile time
+        return False
+    repo = _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    n_sets = max(2, batch // max(1, n_devices))
+    lines = [
+        "import os, sys",
+        "sys.path.insert(0, %r)" % repo,
+        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '')"
+        " + ' --xla_force_host_platform_device_count=%d'" % n_devices,
+        "os.environ['JAX_PLATFORMS'] = 'cpu'",
+        "import jax",
+        "jax.config.update('jax_platforms', 'cpu')",
+        "import numpy as np",
+        "from lodestar_tpu.utils import enable_compile_cache",
+        "enable_compile_cache(%r)" % repo,
+        "from jax.sharding import Mesh",
+        "from lodestar_tpu.models import batch_verify as bv",
+        "sets = bv.make_synthetic_sets(%d, seed=2)" % n_sets,
+        "mesh = Mesh(np.asarray(jax.devices('cpu')[:%d]), ('data',))" % n_devices,
+        "inputs = bv.build_device_inputs(sets, size=%d)" % batch,
+        "pk, h, sig, bits, mask = inputs",
+        "ok = bv.device_batch_verify_sharded(mesh, pk, h, sig, bits, mask)",
+        "print('warmed', bool(np.asarray(ok)))",
+    ]
+    code = "\n".join(lines)
+    env = dict(_os.environ)
+    env["LODESTAR_IN_CACHE_WARMER"] = "1"
+    env["LODESTAR_SHARDED_CACHE_SUBPROCESS"] = "0"
+    try:
+        res = _sp.run(
+            [_sys.executable, "-c", code], env=env, capture_output=True,
+            timeout=3600,
+        )
+        return res.returncode == 0
+    except Exception:
+        return False
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:

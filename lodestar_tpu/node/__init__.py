@@ -198,6 +198,9 @@ class BeaconNode:
             )
             await node.network.start()
             node.notifier.network = node.network
+            # reqresp + router metric bridges (ReqRespMetrics hook; the
+            # notifier's per-slot tick snapshots router/peer gauges)
+            node.network.reqresp.metrics = metrics.reqresp
         node.log.info(
             f"beacon node up: slot {clock.current_slot}, "
             f"rest {'on :' + str(rest_server.port) if rest_server else 'off'}"

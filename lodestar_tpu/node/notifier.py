@@ -53,6 +53,7 @@ class StatusNotifier:
         self.chain = chain
         self.network = network
         self._time = time_fn
+        self.metrics = getattr(chain, "metrics", None)
         self._last_head_slot = 0
         self._last_t = time_fn()
         self.log = get_logger(name="lodestar.notifier")
@@ -81,6 +82,25 @@ class StatusNotifier:
             + f" - peers: {peers}"
         )
         self.log.info(line)
+        m = self.metrics
+        if m is not None:
+            m.sync_detail.head_distance.set(skipped)
+            m.sync_detail.status.set(2 if skipped <= 3 else (1 if speed > 0 else 0))
+            m.peer.peer_count.set(peers)
+            if self.network is not None:
+                gs = getattr(self.network, "gossip", None)
+                if gs is not None:
+                    m.gossip_detail.mcache_size.set(
+                        sum(len(w) for w in gs.mcache)
+                    )
+                    for topic, mesh in gs.mesh.items():
+                        scores = [gs._score(pid) for pid in mesh] or [0.0]
+                        m.gossip_detail.peer_score_by_topic.labels(
+                            topic=topic.split("/")[-2] if topic.count("/") >= 3 else topic
+                        ).set(sum(scores) / len(scores))
+                d5 = getattr(self.network, "discv5", None)
+                if d5 is not None:
+                    m.peer.discv5_sessions.set(len(getattr(d5, "sessions", {})))
         if self.network is not None and peers < LOW_PEER_COUNT:
             self.log.warn(f"low peer count: {peers}")
         return line

@@ -291,14 +291,24 @@ def _conv_p_full(x) -> jax.Array:
 # --- accumulator domain -----------------------------------------------------
 
 
+def _pl():
+    from . import fp_pallas
+
+    return fp_pallas
+
+
 @jax.jit
 def mul_acc(a, b):
     """Product accumulator: value(a)*value(b) as 66 loose limbs."""
+    if _pl().use_pallas():
+        return _pl().mul_acc(a, b)
     return _carry2(_conv_pair(a, b))
 
 
 @jax.jit
 def sq_acc(a):
+    if _pl().use_pallas():
+        return _pl().sq_acc(a)
     return _carry2(_conv_pair(a, a))
 
 
@@ -329,6 +339,8 @@ def redc(t):
     (infinity propagation). The low half s_lo is a multiple of R in
     (-0.02R, 1.02R) — exactly 0 or R — detected by the single-limb
     threshold s_lo[32] >= 2048 (<=1 in the 0 case, >=4095 in the R case)."""
+    if _pl().use_pallas():
+        return _pl().redc(t)
     t = _carry_once(t)  # absorb accumulator sums (limbs <= ~2^15 -> loose)
     m = _carry2(_conv_pprime_low(t[..., :LIMBS]), drop_top=True)  # mod R
     s = _carry2(t + _conv_p_full(m) + jnp.asarray(_TWO_RP))
@@ -342,12 +354,17 @@ def redc(t):
 @jax.jit
 def mont_mul(a, b):
     """Montgomery product abR^{-1} mod p; relaxed in/out, exact-zero
-    preserving."""
+    preserving. Routed to the fused Pallas kernel on TPU backends
+    (ops/fp_pallas.py); this XLA body is the CPU/test path."""
+    if _pl().use_pallas():
+        return _pl().mont_mul(a, b)
     return redc(_carry2(_conv_pair(a, b)))
 
 
 @jax.jit
 def mont_sq(a):
+    if _pl().use_pallas():
+        return _pl().mont_sq(a)
     return redc(_carry2(_conv_pair(a, a)))
 
 

@@ -131,6 +131,8 @@ def fp2_mul_acc(a, b):
 
 
 def fp2_mul(a, b):
+    if a is b:
+        return fp2_sq(a)
     return fp.redc(fp2_mul_acc(a, b))
 
 
@@ -235,11 +237,27 @@ def fp6_mul_acc(a, b):
 
 
 def fp6_mul(a, b):
+    if a is b:
+        return fp6_sq(a)
     return fp.redc(fp6_mul_acc(a, b))
 
 
 def fp6_sq(a):
-    return fp.redc(fp6_mul_acc(a, a))
+    # NOT fp6_mul_acc(a, a): that builds byte-identical lhs/rhs stacks,
+    # the miscompiling shape (see fp12_mul note). The v·shuffled rhs of
+    # the Chung-Hasan-style square keeps operands structurally distinct.
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    # schoolbook via distinct stacked products:
+    # c0 = a0^2 + 2 xi a1 a2; c1 = 2 a0 a1 + xi a2^2; c2 = a1^2 + 2 a0 a2
+    m = fp2_mul_acc(
+        jnp.stack([a0, a1, a2, a0, a1, a0], axis=-3),
+        jnp.stack([a0, a2, a2, a1, a1, a2], axis=-3),
+    )
+    sq0, m12, sq2, m01, sq1, m02 = (m[..., i, :, :] for i in range(6))
+    c0 = fp.acc_add(sq0, _a2_mul_xi(fp.acc_add(m12, m12)))
+    c1 = fp.acc_add(fp.acc_add(m01, m01), _a2_mul_xi(sq2))
+    c2 = fp.acc_add(sq1, fp.acc_add(m02, m02))
+    return fp.redc(jnp.stack([c0, c1, c2], axis=-3))
 
 
 def fp6_mul_by_v(a):
@@ -286,7 +304,11 @@ def fp12_mul(a, b):
     """Karatsuba Fp12 product: all 54 base-field products ride ONE conv
     dispatch chain (3 stacked fp6_mul_acc -> 18 fp2 -> 54 convs), the
     combine is elementwise acc ops, and ONE stacked reduction materializes
-    the 12 coefficients."""
+    the 12 coefficients. Same-object operands route to the Karatsuba
+    square: identical-operand Mosaic calls inside large jitted programs
+    deterministically miscompiled on the v5e (squaring is also cheaper)."""
+    if a is b:
+        return fp12_sq(a)
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
     lhs = jnp.stack([a0, a1, fp6_add(a0, a1)], axis=-4)
@@ -319,10 +341,12 @@ def fp12_conj(a):
 def fp12_inv(a):
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     both = jnp.stack([a0, a1], axis=-4)
-    sq = fp6_mul_acc(both, both)  # a0^2, a1^2 accs in one dispatch
-    t = fp.redc(
-        fp.acc_sub(sq[..., 0, :, :, :], _a6_mul_by_v(sq[..., 1, :, :, :]))
-    )
+    # NOT fp6_mul_acc(both, both): identical-operand Mosaic calls inside
+    # large jitted programs miscompiled on the v5e (see fp12_mul note) —
+    # the distinct-stack fp6_sq covers each half
+    s0 = fp6_sq(a0)
+    s1 = fp6_sq(a1)
+    t = fp6_sub(s0, fp6_mul_by_v(s1))
     tinv = fp6_inv(t)
     scaled = fp6_mul(both, tinv[..., None, :, :, :])
     return jnp.stack(

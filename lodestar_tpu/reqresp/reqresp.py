@@ -55,6 +55,8 @@ class ReqResp:
         self._default_quota = default_quota
         self._timeout = request_timeout_sec
         self._streams_served = 0
+        # optional ReqRespMetrics (set by the node wiring); None = no-op
+        self.metrics = None
         # fork-context resolvers (set_fork_context) for ForkDigest protocols
         self._fork_to_digest: Callable[[str], bytes] | None = None
         self._digest_to_fork: Callable[[bytes], str | None] | None = None
@@ -92,8 +94,12 @@ class ReqResp:
             if handler is None:
                 await write_response_chunk(writer, RespStatus.INVALID_REQUEST, b"")
                 return
+            if self.metrics is not None:
+                self.metrics.requests_received.labels(protocol=protocol_id).inc()
             limiter = self._limiters[protocol_id]
             if not limiter.allows(peer_id):
+                if self.metrics is not None:
+                    self.metrics.rate_limited.labels(protocol=protocol_id).inc()
                 await write_response_chunk(writer, RespStatus.RATE_LIMITED, b"")
                 return
             # bound per-peer bucket growth from untrusted peer-id churn
@@ -133,9 +139,16 @@ class ReqResp:
                     )
                     count += 1
             except ReqRespError as e:
+                if self.metrics is not None:
+                    self.metrics.request_errors.labels(protocol=protocol_id).inc()
                 await write_response_chunk(writer, RespStatus.INVALID_REQUEST, str(e).encode()[:256])
             except Exception:
+                if self.metrics is not None:
+                    self.metrics.request_errors.labels(protocol=protocol_id).inc()
                 await write_response_chunk(writer, RespStatus.SERVER_ERROR, b"")
+            else:
+                if self.metrics is not None:
+                    self.metrics.response_chunks_sent.labels(protocol=protocol_id).inc(count)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass  # peer hung up mid-negotiation; nothing to answer
         finally:
@@ -159,7 +172,14 @@ class ReqResp:
         (TTFB/RESP timeouts in the reference) so a dead peer can never
         hang the caller."""
         proto = protocol_by_id(protocol_id)
-        reader, writer = await asyncio.wait_for(dial(), self._timeout)
+        if self.metrics is not None:
+            self.metrics.requests_sent.labels(protocol=protocol_id).inc()
+        try:
+            reader, writer = await asyncio.wait_for(dial(), self._timeout)
+        except asyncio.TimeoutError:
+            if self.metrics is not None:
+                self.metrics.dial_timeouts.inc()
+            raise
         try:
             pid = protocol_id.encode()
             writer.write(len(pid).to_bytes(2, "big") + pid)
