@@ -30,11 +30,11 @@ import numpy as np
 
 REFERENCE_SIGS_PER_SEC_PER_CORE = 2200.0  # blst envelope, see module docstring
 BATCH = 128  # sets per gossip job (the north-star workload unit)
-# buffered jobs merged into one RLC device batch. Swept on the real v5e-1:
-# 8 jobs (1024 sets) -> 786 sigs/s, 32 -> 1250, 128 -> 1153; the knee is
-# ~32 jobs where the program stops being latency-bound. Overridable for
-# batch-width sweeps.
-MERGE_JOBS = int(os.environ.get("LODESTAR_BENCH_MERGE_JOBS", "32"))
+# buffered jobs merged into one RLC device batch. Swept on the real
+# v5e-1 with the r5 Pallas core: 16 -> 6781 sigs/s, 32 -> 6586,
+# 64 -> 5353 (PERF.md) — the r4 knee of 32 moved to 16 with the faster
+# program. Overridable for batch-width sweeps.
+MERGE_JOBS = int(os.environ.get("LODESTAR_BENCH_MERGE_JOBS", "16"))
 ITERS = 3
 
 

@@ -196,9 +196,9 @@ def networking():
         panel(
             "ReqResp",
             [
-                ("rate(beacon_reqresp_outgoing_requests_total[1m])", "out {{method}}"),
-                ("rate(beacon_reqresp_incoming_requests_total[1m])", "in {{method}}"),
-                ("rate(beacon_reqresp_outgoing_errors_total[1m])", "errors {{method}}"),
+                ("rate(beacon_reqresp_outgoing_requests_total[1m])", "out {{protocol}}"),
+                ("rate(beacon_reqresp_incoming_requests_total[1m])", "in {{protocol}}"),
+                ("rate(beacon_reqresp_incoming_errors_total[1m])", "errors {{protocol}}"),
             ],
             unit="ops", x=0, y=8, pid=3,
         ),
@@ -273,12 +273,146 @@ def main():
         ("lodestar_block_processor.json", block_processor()),
         ("lodestar_networking.json", networking()),
         ("lodestar_validator_monitor.json", validator_monitor()),
+        ("lodestar_sync.json", sync_dashboard()),
+        ("lodestar_reqresp_api.json", reqresp_api_dashboard()),
+        ("lodestar_db.json", db_dashboard()),
     ):
         path = os.path.join(OUT, name)
         with open(path, "w") as f:
             json.dump(dash, f, indent=2)
             f.write("\n")
         print(f"wrote {path}")
+
+
+
+def sync_dashboard():
+    ps = [
+        panel("Sync status", [("lodestar_sync_status", "status (0 stalled/1 syncing/2 synced)")], pid=1),
+        panel("Head distance (slots behind)", [("lodestar_sync_head_distance_slots", "behind")], x=12, pid=2),
+        panel(
+            "Range-sync batches",
+            [
+                ("rate(lodestar_sync_range_batches_total[5m])", "{{status}}"),
+                ("rate(lodestar_sync_range_batches_downloaded_total[5m])", "downloaded"),
+                ("rate(lodestar_sync_range_download_retries_total[5m])", "retries"),
+            ],
+            y=8, pid=3,
+        ),
+        panel(
+            "Blocks imported by sync",
+            [
+                ("rate(lodestar_sync_range_blocks_total[5m])", "range"),
+                ("rate(lodestar_backfill_sync_blocks_total[5m])", "backfill"),
+            ],
+            x=12, y=8, pid=4,
+        ),
+        panel(
+            "Batch latency p95",
+            [
+                ("histogram_quantile(0.95, rate(lodestar_sync_range_batch_download_seconds_bucket[5m]))", "download"),
+                ("histogram_quantile(0.95, rate(lodestar_sync_range_batch_processing_seconds_bucket[5m]))", "processing"),
+            ],
+            unit="s", y=16, pid=5,
+        ),
+        panel(
+            "Backfill / unknown-block",
+            [
+                ("lodestar_backfill_earliest_slot", "backfill earliest slot"),
+                ("lodestar_sync_unknown_block_pending_count", "unknown-block pending"),
+                ("rate(lodestar_sync_unknown_block_requests_total[5m])", "unknown-block requests"),
+            ],
+            x=12, y=16, pid=6,
+        ),
+    ]
+    return dashboard("lodestar-sync", "Lodestar TPU - Sync", ps, ["lodestar", "sync"])
+
+
+def reqresp_api_dashboard():
+    ps = [
+        panel(
+            "Req/resp requests",
+            [
+                ("sum by (protocol) (rate(beacon_reqresp_incoming_requests_total[5m]))", "in {{protocol}}"),
+                ("sum by (protocol) (rate(beacon_reqresp_outgoing_requests_total[5m]))", "out {{protocol}}"),
+            ],
+            pid=1,
+        ),
+        panel(
+            "Req/resp chunks + errors",
+            [
+                ("sum by (protocol) (rate(beacon_reqresp_outgoing_response_chunks_total[5m]))", "chunks {{protocol}}"),
+                ("sum by (protocol) (rate(beacon_reqresp_incoming_errors_total[5m]))", "errors {{protocol}}"),
+                ("sum by (protocol) (rate(beacon_reqresp_rate_limited_total[5m]))", "rate-limited {{protocol}}"),
+            ],
+            x=12, pid=2,
+        ),
+        panel(
+            "REST API requests",
+            [
+                ("sum by (method, status) (rate(lodestar_api_rest_requests_total[5m]))", "{{method}} {{status}}"),
+                ("rate(lodestar_api_rest_errors_total[5m])", "5xx"),
+            ],
+            y=8, pid=3,
+        ),
+        panel(
+            "REST response time p95",
+            [("histogram_quantile(0.95, rate(lodestar_api_rest_response_time_seconds_bucket[5m]))", "p95")],
+            unit="s", x=12, y=8, pid=4,
+        ),
+        panel(
+            "Dial health",
+            [
+                ("rate(beacon_reqresp_dial_timeouts_total[5m])", "dial timeouts"),
+                ("rate(beacon_reqresp_streams_reset_total[5m])", "streams reset"),
+            ],
+            y=16, pid=5,
+        ),
+    ]
+    return dashboard("lodestar-reqresp-api", "Lodestar TPU - ReqResp and REST API", ps, ["lodestar", "api"])
+
+
+def db_dashboard():
+    ps = [
+        panel(
+            "DB requests",
+            [
+                ("sum by (bucket) (rate(lodestar_db_read_req_total[5m]))", "read {{bucket}}"),
+                ("sum by (bucket) (rate(lodestar_db_write_req_total[5m]))", "write {{bucket}}"),
+            ],
+            pid=1,
+        ),
+        panel(
+            "DB items",
+            [
+                ("sum by (bucket) (rate(lodestar_db_read_items_total[5m]))", "read {{bucket}}"),
+                ("sum by (bucket) (rate(lodestar_db_write_items_total[5m]))", "write {{bucket}}"),
+            ],
+            x=12, pid=2,
+        ),
+        panel(
+            "Size",
+            [
+                ("lodestar_db_size_bytes", "db"),
+                ("lodestar_db_wal_size_bytes", "wal"),
+            ],
+            unit="bytes", y=8, pid=3,
+        ),
+        panel(
+            "Archive / prune",
+            [
+                ("rate(lodestar_db_archived_states_total[5m])", "states archived"),
+                ("rate(lodestar_db_archived_blocks_total[5m])", "blocks archived"),
+                ("rate(lodestar_db_pruned_blocks_total[5m])", "blocks pruned"),
+            ],
+            x=12, y=8, pid=4,
+        ),
+        panel(
+            "Batch write latency p95",
+            [("histogram_quantile(0.95, rate(lodestar_db_batch_write_seconds_bucket[5m]))", "p95")],
+            unit="s", y=16, pid=5,
+        ),
+    ]
+    return dashboard("lodestar-db", "Lodestar TPU - Database", ps, ["lodestar", "db"])
 
 
 if __name__ == "__main__":
