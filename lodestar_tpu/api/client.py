@@ -63,6 +63,12 @@ class BeaconApiClient:
     def get_state_validators(self, state_id: str):
         return self._req("GET", f"/eth/v1/beacon/states/{state_id}/validators")
 
+    def submit_pool_proposer_slashing(self, slashing_json: dict):
+        return self._req("POST", "/eth/v1/beacon/pool/proposer_slashings", body=slashing_json)
+
+    def submit_pool_attester_slashing(self, slashing_json: dict):
+        return self._req("POST", "/eth/v1/beacon/pool/attester_slashings", body=slashing_json)
+
     def submit_pool_attestations(self, attestations_json: list):
         return self._req("POST", "/eth/v1/beacon/pool/attestations", body=attestations_json)
 

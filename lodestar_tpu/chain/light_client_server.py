@@ -49,6 +49,10 @@ class LightClientServer:
         parent_block = self.chain.get_block_by_root(parent_root)
         if parent_block is None:
             return
+        if fork_of(attested_state) == "phase0":
+            # the first altair block attests a phase0 parent: no sync
+            # committee to prove yet
+            return
 
         update = t.LightClientUpdate.default()
         att = t.LightClientHeader.default()

@@ -17,9 +17,18 @@ import sys
 __all__ = ["main"]
 
 
-def _build_parser() -> argparse.ArgumentParser:
+def _build_parser(with_subparsers: bool = False):
     ap = argparse.ArgumentParser(prog="lodestar-tpu", description="TPU-native beacon chain framework")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    subparsers: list = []
+    _add = sub.add_parser
+
+    def add_parser(*a, **kw):
+        sp = _add(*a, **kw)
+        subparsers.append(sp)
+        return sp
+
+    sub.add_parser = add_parser
 
     dev = sub.add_parser("dev", help="single-process dev chain: node + validators")
     dev.add_argument("--validators", type=int, default=16)
@@ -61,8 +70,96 @@ def _build_parser() -> argparse.ArgumentParser:
     val.add_argument("--keymanager-port", type=int, default=0, help="serve the keymanager API on this port")
     val.add_argument("--data-dir", default=None, help="persist slashing protection here (STRONGLY recommended)")
 
+    lc = sub.add_parser("lightclient", help="run the driving light client against a beacon API")
+    lc.add_argument("--server", default="http://127.0.0.1:9596", help="beacon API base URL")
+    lc.add_argument("--checkpoint-root", default=None, help="trusted block root (hex; default: the server's finalized root)")
+    lc.add_argument("--preset", default="minimal", choices=["minimal", "mainnet"])
+    lc.add_argument("--target-slot", type=int, default=0, help="exit 0 once the light head reaches this slot (0 = follow forever)")
+    lc.add_argument("--poll-sec", type=float, default=2.0)
+
     sub.add_parser("bench", help="run the device benchmark")
+    if with_subparsers:
+        return ap, subparsers
     return ap
+
+
+def _apply_rc_config(ap, sub_actions, argv):
+    """--rc-config <yaml> / --rc-config=<yaml>: file values become
+    argument defaults, CLI flags still win (reference `cli.ts:5`
+    rcConfigOption). Keys use the flag spelling (dashes or underscores);
+    keys matching no known argument are rejected loudly."""
+    path = None
+    rest = []
+    it = iter(argv)
+    for a in it:
+        if a == "--rc-config":
+            path = next(it, None)
+            if path is None:
+                raise SystemExit("--rc-config requires a file path")
+        elif a.startswith("--rc-config="):
+            path = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    if path is None:
+        return argv
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise SystemExit(f"--rc-config {path}: expected a mapping")
+    defaults = {str(k).replace("-", "_"): v for k, v in raw.items()}
+    known = {a.dest for sp in sub_actions for a in sp._actions}
+    unknown = sorted(set(defaults) - known)
+    if unknown:
+        raise SystemExit(f"--rc-config {path}: unknown keys {unknown}")
+    ap.set_defaults(**defaults)
+    for sp in sub_actions:
+        sp.set_defaults(**defaults)
+    return rest
+
+
+async def _run_lightclient(args) -> int:
+    import time as _time
+
+    from lodestar_tpu import params
+    from lodestar_tpu.api.client import BeaconApiClient
+    from lodestar_tpu.light_client.client import Lightclient
+
+    params.set_active_preset(args.preset)
+    client = BeaconApiClient(args.server)
+    genesis = client.get_genesis()["data"]
+    gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+    fork = client.get_state_fork("head")["data"]
+    fork_version = bytes.fromhex(fork["current_version"][2:])
+    cp = args.checkpoint_root or "finalized"
+    if cp in ("head", "finalized", "justified", "genesis"):
+        root_hex = client.get_block_root(cp)["data"]["root"]
+    else:
+        root_hex = cp
+    trusted = bytes.fromhex(root_hex[2:] if root_hex.startswith("0x") else root_hex)
+
+    lc = Lightclient(
+        transport=client, genesis_validators_root=gvr, fork_version=fork_version
+    )
+    lc.on_head(lambda h: print(f"light head: slot {int(h.beacon.slot)}", flush=True))
+    lc.bootstrap(trusted)
+    print(f"bootstrapped from {root_hex[:18]}…, finalized slot {lc.finalized_slot}", flush=True)
+    genesis_time = int(genesis["genesis_time"])
+    spec = client.get_spec()["data"]
+    seconds_per_slot = int(spec.get("SECONDS_PER_SLOT", 12))
+    while True:
+        current_slot = max(0, int(_time.time()) - genesis_time) // max(1, seconds_per_slot)
+        lc.sync_to_head(current_slot=current_slot)
+        lc.poll_head()
+        print(
+            f"finalized {lc.finalized_slot} head {lc.head_slot} status {lc.status}",
+            flush=True,
+        )
+        if args.target_slot and lc.head_slot >= args.target_slot:
+            print(f"target slot {args.target_slot} reached", flush=True)
+            return 0
+        await asyncio.sleep(args.poll_sec)
 
 
 async def _run_dev(args) -> int:
@@ -480,11 +577,18 @@ def main(argv: list[str] | None = None) -> int:
             _jax.config.update("jax_platforms", plat)
         except Exception:
             pass
-    args = _build_parser().parse_args(argv)
+    ap, sub_actions = _build_parser(with_subparsers=True)
+    import sys as _sys
+
+    argv = list(_sys.argv[1:] if argv is None else argv)
+    argv = _apply_rc_config(ap, sub_actions, argv)
+    args = ap.parse_args(argv)
     if args.cmd == "dev":
         return asyncio.run(_run_dev(args))
     if args.cmd == "beacon":
         return asyncio.run(_run_beacon(args))
+    if args.cmd == "lightclient":
+        return asyncio.run(_run_lightclient(args))
     if args.cmd == "validator":
         return asyncio.run(_run_validator(args))
     if args.cmd == "bench":

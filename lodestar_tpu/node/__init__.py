@@ -149,6 +149,15 @@ class BeaconNode:
             current_slot=max(clock.current_slot, anchor_state.slot),
             metrics=metrics,
         )
+        # light-client server: serves bootstraps/updates once the chain
+        # runs altair+ (reference chain/lightClient/index.ts wired in
+        # BeaconChain's constructor)
+        from lodestar_tpu.params import FAR_FUTURE_EPOCH
+
+        if chain_config is not None and chain_config.ALTAIR_FORK_EPOCH != FAR_FUTURE_EPOCH:
+            from lodestar_tpu.chain.light_client_server import LightClientServer
+
+            chain.light_client_server = LightClientServer(chain)
         clock.on_slot(chain.on_slot)
         if not opts.manual_clock:
             clock.start()
