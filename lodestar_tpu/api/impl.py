@@ -1074,6 +1074,27 @@ class BeaconApiImpl:
                 )
         return {"data": heads}
 
+    def get_slot_traces(self, slot: str, fmt: str = "json") -> dict:
+        """Completed pipeline traces for a slot from the tracer's ring
+        buffer (`lodestar_tpu/tracing`). fmt="chrome" returns one Chrome
+        `trace_event` document — UNWRAPPED (no {"data"} envelope), so a
+        curl'd response loads in chrome://tracing/Perfetto as-is."""
+        from lodestar_tpu import tracing
+
+        traces = tracing.get_tracer().traces_for_slot(int(slot))
+        if fmt == "chrome":
+            from lodestar_tpu.tracing.export import to_chrome_trace
+
+            return to_chrome_trace(traces)
+        return {"data": [t.to_dict() for t in traces]}
+
+    def get_recent_traces(self, count: int = 16) -> dict:
+        """The newest completed traces in the ring, oldest first."""
+        from lodestar_tpu import tracing
+
+        traces = tracing.get_tracer().recent_traces(count)
+        return {"data": [t.to_dict() for t in traces]}
+
     def get_fork_choice_nodes(self) -> dict:
         fc = self.chain.fork_choice.proto_array
         return {

@@ -76,6 +76,8 @@ ROUTES: list[tuple[str, str, str]] = [
     ("GET", r"/eth/v1/debug/beacon/heads", "r_debug_heads"),
     ("GET", r"/eth/v2/debug/beacon/heads", "r_debug_heads"),
     ("GET", r"/eth/v0/debug/forkchoice", "r_debug_forkchoice"),
+    ("GET", r"/eth/v0/debug/traces", "r_debug_traces_recent"),
+    ("GET", r"/eth/v0/debug/traces/(?P<slot>\d+)", "r_debug_traces"),
     ("GET", r"/eth/v1/config/spec", "r_spec"),
     ("GET", r"/eth/v1/config/fork_schedule", "r_fork_schedule"),
     ("GET", r"/eth/v1/config/deposit_contract", "r_deposit_contract"),
@@ -279,6 +281,17 @@ class _Router:
 
     def r_debug_forkchoice(self, **kw):
         return self.api.get_fork_choice_nodes()
+
+    def r_debug_traces(self, slot, query=None, **kw):
+        return self.api.get_slot_traces(slot, fmt=(query or {}).get("format", "json"))
+
+    def r_debug_traces_recent(self, query=None, **kw):
+        raw = (query or {}).get("count", "16")
+        try:
+            count = int(raw)
+        except ValueError:
+            raise ApiError(400, f"count must be an integer, got {raw!r}") from None
+        return self.api.get_recent_traces(count)
 
     def r_fork_schedule(self, **kw):
         return self.api.get_fork_schedule()

@@ -33,6 +33,7 @@ import asyncio
 import time
 from typing import Awaitable, Callable, Sequence
 
+from lodestar_tpu import tracing
 from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.logger import get_logger
 
@@ -73,13 +74,18 @@ def chunkify_maximize_chunk_size(arr: Sequence, max_len: int) -> list[list]:
 
 
 class _Job:
-    __slots__ = ("sets", "batchable", "future", "added_ms")
+    __slots__ = ("sets", "batchable", "future", "added_ns", "trace_parent")
 
     def __init__(self, sets: list[SignatureSet], batchable: bool):
         self.sets = sets
         self.batchable = batchable
         self.future: asyncio.Future[bool] = asyncio.get_event_loop().create_future()
-        self.added_ms = time.monotonic() * 1000.0
+        # the submitting task's span (None when tracing is off): the
+        # executor thread parents its buffer-wait/device-launch spans on
+        # it explicitly, since run_in_executor drops contextvars. The
+        # clock read rides the same gate — untraced jobs pay nothing
+        self.trace_parent = tracing.current()
+        self.added_ns = time.monotonic_ns() if self.trace_parent is not None else 0
 
 
 class BlsDeviceVerifierPool(IBlsVerifier):
@@ -225,6 +231,21 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         self.metrics["jobs_started"] += len(package)
         self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
 
+        # tracing work (incl. the clock reads) only when some job in the
+        # package was submitted under an active trace — the disabled path
+        # pays the flag checks hidden in trace_parent alone
+        traced = any(j.trace_parent is not None for j in package)
+        if traced:
+            # buffer-wait spans: from job submission to the launch this
+            # thread is about to perform (buffering + queue time)
+            launch_ns = time.monotonic_ns()
+            for j in package:
+                if j.trace_parent is not None:
+                    tracing.record(
+                        j.trace_parent, "bls_buffer_wait", j.added_ns, launch_ns,
+                        {"sets": len(j.sets)},
+                    )
+
         batchable = [j for j in package if j.batchable]
         individual = [j for j in package if not j.batchable]
 
@@ -234,13 +255,18 @@ class BlsDeviceVerifierPool(IBlsVerifier):
 
         for chunk in chunkify_maximize_chunk_size(batchable, BATCHABLE_MIN_PER_CHUNK):
             all_sets = [s for j in chunk for s in j.sets]
+            t0 = time.monotonic_ns() if traced else 0
             try:
                 with trace_region("bls_batch_verify"):
                     ok = self._verify_fn(all_sets)
             except Exception:
                 self.metrics["batch_retries"] += 1
+                if traced:
+                    self._trace_launch(chunk, t0, len(all_sets), "batch_error")
                 individual.extend(chunk)
                 continue
+            if traced:
+                self._trace_launch(chunk, t0, len(all_sets), "batch")
             if ok:
                 self.metrics["batch_sigs_success"] += len(all_sets)
                 for j in chunk:
@@ -250,11 +276,36 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 individual.extend(chunk)
 
         for j in individual:
+            t0 = time.monotonic_ns() if traced else 0
             try:
-                self._resolve(j, self._verify_fn(j.sets))
+                ok = self._verify_fn(j.sets)
+                if traced:
+                    self._trace_launch([j], t0, len(j.sets), "single")
+                self._resolve(j, ok)
             except Exception as e:
+                if traced:
+                    self._trace_launch([j], t0, len(j.sets), "single_error")
                 if not j.future.done():
                     j.future.get_loop().call_soon_threadsafe(self._reject, j, e)
+
+    @staticmethod
+    def _trace_launch(jobs: list[_Job], start_ns: int, n_sets: int, mode: str) -> None:
+        """Per-traced-job device-launch span; a batch covering jobs from
+        several traces lands one identically-timed span in each. A
+        batchable job verified in the single pass got there because its
+        batch failed — that's the reference's batch-then-retry path, so
+        it's labeled bls_batch_retry to keep the decomposition visible."""
+        end_ns = time.monotonic_ns()
+        for j in jobs:
+            if j.trace_parent is not None:
+                retried = j.batchable and mode.startswith("single")
+                tracing.record(
+                    j.trace_parent,
+                    "bls_batch_retry" if retried else "bls_device_launch",
+                    start_ns,
+                    end_ns,
+                    {"sets": n_sets, "mode": mode},
+                )
 
     def _resolve(self, job: _Job, result: bool) -> None:
         if not job.future.done():

@@ -21,6 +21,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from lodestar_tpu import tracing
 from lodestar_tpu.db import Bucket, DbController, Repository
 from lodestar_tpu.fork_choice import Checkpoint, ForkChoice, ProtoBlock
 from lodestar_tpu.logger import get_logger
@@ -325,7 +326,34 @@ class BeaconChain:
         with self.import_lock:
             return await self._process_block_locked(signed_block, is_timely=is_timely)
 
+    # sanity rejections before any pipeline work — their traces are
+    # discarded so no-op imports (sync duplicates) don't flood the ring
+    _NOOP_IMPORT_CODES = frozenset(
+        (
+            BlockErrorCode.ALREADY_KNOWN,
+            BlockErrorCode.PARENT_UNKNOWN,
+            BlockErrorCode.WOULD_REVERT_FINALIZED,
+            BlockErrorCode.FUTURE_SLOT,
+        )
+    )
+
     async def _process_block_locked(self, signed_block, *, is_timely: bool = False):
+        # root when called directly (sync/REST paths); child span when the
+        # gossip processor already opened the slot's block_import trace
+        with tracing.root("process_block", slot=int(signed_block.message.slot)):
+            try:
+                return await self._process_block_traced(signed_block, is_timely=is_timely)
+            except BlockError as e:
+                # the post-verification ALREADY_KNOWN race re-check sets
+                # pipeline_ran: that trace measured real device/STF work
+                # and must survive for the slow-slot dump
+                if e.code in self._NOOP_IMPORT_CODES and not getattr(
+                    e, "pipeline_ran", False
+                ):
+                    tracing.discard()
+                raise
+
+    async def _process_block_traced(self, signed_block, *, is_timely: bool = False):
         t = self.types
         block = signed_block.message
         block_type, signed_type = self.block_type_at_slot(block.slot)
@@ -347,31 +375,45 @@ class BeaconChain:
             raise BlockError(BlockErrorCode.PARENT_UNKNOWN, _hex(parent_root))
 
         # 2. pre-state + dial to block slot
-        pre_state = self.get_state_by_block_root(parent_root)
-        work_state = pre_state.copy()
-        if block.slot > work_state.slot:
-            ctx = process_slots(work_state, block.slot, self.p, self.cfg)
-        else:
-            ctx = EpochContext(work_state, self.p)
+        with tracing.span("pre_state_regen"):
+            pre_state = self.get_state_by_block_root(parent_root)
+            work_state = pre_state.copy()
+            if block.slot > work_state.slot:
+                ctx = process_slots(work_state, block.slot, self.p, self.cfg)
+            else:
+                ctx = EpochContext(work_state, self.p)
 
         # 3. parallel: signature-free STF on this task + batched signature
         # verification through the device pool (verifyBlock.ts:89-111)
         import asyncio
 
         sets = get_block_signature_sets(work_state, signed_block, ctx)
-        sig_task = asyncio.ensure_future(
-            self.bls.verify_signature_sets(sets, VerifySignatureOpts(batchable=False))
-        )
+
+        async def run_sigs():
+            # own task: ensure_future snapshots the context, so the span
+            # stitches under this import's trace; pool jobs capture it as
+            # their parent for the buffer-wait/device-launch spans
+            with tracing.span("bls_verify") as sp:
+                if sp:
+                    sp.set(sets=len(sets))
+                return await self.bls.verify_signature_sets(
+                    sets, VerifySignatureOpts(batchable=False)
+                )
+
+        sig_task = asyncio.ensure_future(run_sigs())
+        stf_parent = tracing.current()  # executor threads don't see contextvars
 
         def run_stf():
             from lodestar_tpu.state_transition import BlockProcessError, StateTransitionError
 
             post = work_state  # already copied + dialed
             try:
-                process_block(post, block, ctx, verify_signatures=False, cfg=self.cfg)
+                with tracing.span("state_transition", parent=stf_parent):
+                    process_block(post, block, ctx, verify_signatures=False, cfg=self.cfg)
             except (BlockProcessError, StateTransitionError) as e:
                 raise BlockError(BlockErrorCode.INVALID_STATE_TRANSITION, str(e)) from e
-            got = post.type.hash_tree_root(post)
+            with tracing.span("hash_tree_root", parent=stf_parent):
+                got = post.type.hash_tree_root(post)
             if got != bytes(block.state_root):
                 raise BlockError(BlockErrorCode.INVALID_STATE_TRANSITION, "state root mismatch")
             return post
@@ -380,8 +422,8 @@ class BeaconChain:
         results = await asyncio.gather(stf_task, sig_task, return_exceptions=True)
         stf_res, sig_res = results
         if isinstance(stf_res, BaseException):
-            if not sig_task.done():
-                sig_task.cancel()
+            # gather(return_exceptions=True) already waited out sig_task;
+            # a failing STF still pays for the in-flight verification
             raise stf_res
         if isinstance(sig_res, BaseException):
             # fail closed: a verifier/transport error rejects the block
@@ -396,9 +438,12 @@ class BeaconChain:
         # signature verification (asyncio interleaves at awaits; the
         # RLock only excludes across threads)
         if self.fork_choice.proto_array.has_block(_hex(block_root)):
-            raise BlockError(BlockErrorCode.ALREADY_KNOWN, _hex(block_root))
-        self.blocks_db.put_binary(block_root, signed_type.serialize(signed_block))
-        self.state_cache.add(block_root, post_state)
+            err = BlockError(BlockErrorCode.ALREADY_KNOWN, _hex(block_root))
+            err.pipeline_ran = True
+            raise err
+        with tracing.span("persist_block"):
+            self.blocks_db.put_binary(block_root, signed_type.serialize(signed_block))
+            self.state_cache.add(block_root, post_state)
 
         blk_epoch = compute_epoch_at_slot(block.slot, self.p)
         jc = post_state.current_justified_checkpoint
@@ -417,43 +462,47 @@ class BeaconChain:
             unrealized_finalized_epoch=fc_cp.epoch,
         )
         prev_finalized = self.fork_choice.finalized.epoch
-        self.fork_choice.on_block(
-            proto,
-            is_timely=is_timely,
-            justified_checkpoint=Checkpoint(jc.epoch, _hex(bytes(jc.root))),
-            finalized_checkpoint=Checkpoint(fc_cp.epoch, _hex(bytes(fc_cp.root))),
-            justified_balances=effective_balances_array(post_state),
-        )
-
-        # operation attestations feed LMD votes (importBlock.ts:130) and
-        # the liveness record (doppelganger data source: on-chain activity
-        # counts, not just gossip — reference validatorMonitor)
-        blk_proposer_epoch = compute_epoch_at_slot(block.slot, self.p)
-        self.seen_block_proposers.add(blk_proposer_epoch, int(block.proposer_index))
-        monitor = self.metrics.validator_monitor if self.metrics is not None else None
-        if monitor is not None:
-            monitor.on_block_imported(int(block.slot), int(block.proposer_index))
-        for att in block.body.attestations:
-            try:
-                attesting = ctx.get_attesting_indices(att.data, att.aggregation_bits)
-            except ValueError:
-                continue
-            for i in attesting:
-                self.seen_block_attesters.add(int(att.data.target.epoch), int(i))
-            if monitor is not None:
-                monitor.on_attestation_in_block(
-                    int(att.data.target.epoch),
-                    [int(i) for i in attesting],
-                    int(block.slot) - int(att.data.slot),
-                )
-            self.fork_choice.on_attestation(
-                [int(i) for i in attesting],
-                _hex(bytes(att.data.beacon_block_root)),
-                att.data.target.epoch,
-                att.data.slot,
+        with tracing.span("fork_choice"):
+            self.fork_choice.on_block(
+                proto,
+                is_timely=is_timely,
+                justified_checkpoint=Checkpoint(jc.epoch, _hex(bytes(jc.root))),
+                finalized_checkpoint=Checkpoint(fc_cp.epoch, _hex(bytes(fc_cp.root))),
+                justified_balances=effective_balances_array(post_state),
             )
 
-        head = self.fork_choice.update_head()
+            # operation attestations feed LMD votes (importBlock.ts:130) and
+            # the liveness record (doppelganger data source: on-chain activity
+            # counts, not just gossip — reference validatorMonitor). Child
+            # span: committee computation + monitor bookkeeping dominate
+            # here and must not read as fork-choice time in dumps/metrics
+            with tracing.span("attestation_ops"):
+                blk_proposer_epoch = compute_epoch_at_slot(block.slot, self.p)
+                self.seen_block_proposers.add(blk_proposer_epoch, int(block.proposer_index))
+                monitor = self.metrics.validator_monitor if self.metrics is not None else None
+                if monitor is not None:
+                    monitor.on_block_imported(int(block.slot), int(block.proposer_index))
+                for att in block.body.attestations:
+                    try:
+                        attesting = ctx.get_attesting_indices(att.data, att.aggregation_bits)
+                    except ValueError:
+                        continue
+                    for i in attesting:
+                        self.seen_block_attesters.add(int(att.data.target.epoch), int(i))
+                    if monitor is not None:
+                        monitor.on_attestation_in_block(
+                            int(att.data.target.epoch),
+                            [int(i) for i in attesting],
+                            int(block.slot) - int(att.data.slot),
+                        )
+                    self.fork_choice.on_attestation(
+                        [int(i) for i in attesting],
+                        _hex(bytes(att.data.beacon_block_root)),
+                        att.data.target.epoch,
+                        att.data.slot,
+                    )
+
+            head = self.fork_choice.update_head()
         if self.light_client_server is not None:
             self.light_client_server.on_imported_block(signed_block, post_state)
         self._emit("block", block_root, signed_block)

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from lodestar_tpu import tracing
 from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.params import (
     DOMAIN_AGGREGATE_AND_PROOF,
@@ -220,21 +221,24 @@ def validate_gossip_aggregate_and_proof(chain, signed_agg) -> AttestationValidat
 
 def validate_gossip_block(chain, signed_block) -> None:
     """beacon_block topic checks (reference `validation/block.ts`)."""
-    p = chain.p
-    block = signed_block.message
-    current_slot = chain.fork_choice.current_slot
-    if block.slot > current_slot:
-        raise GossipValidationError(GossipAction.IGNORE, "future slot")
-    finalized_slot = chain.fork_choice.finalized.epoch * p.SLOTS_PER_EPOCH
-    if block.slot <= finalized_slot:
-        raise GossipValidationError(GossipAction.IGNORE, "finalized slot")
-    root_hex = "0x" + bytes(block.parent_root).hex()
-    if chain.fork_choice.proto_array.get_block(root_hex) is None:
-        raise GossipValidationError(GossipAction.IGNORE, "parent unknown")
-    block_type, _signed = chain.block_type_at_slot(int(block.slot))
-    block_root = block_type.hash_tree_root(block)
-    if chain.fork_choice.proto_array.has_block("0x" + block_root.hex()):
-        raise GossipValidationError(GossipAction.IGNORE, "already known")
+    with tracing.span("gossip_validation") as sp:
+        if sp:
+            sp.set(topic="beacon_block")
+        p = chain.p
+        block = signed_block.message
+        current_slot = chain.fork_choice.current_slot
+        if block.slot > current_slot:
+            raise GossipValidationError(GossipAction.IGNORE, "future slot")
+        finalized_slot = chain.fork_choice.finalized.epoch * p.SLOTS_PER_EPOCH
+        if block.slot <= finalized_slot:
+            raise GossipValidationError(GossipAction.IGNORE, "finalized slot")
+        root_hex = "0x" + bytes(block.parent_root).hex()
+        if chain.fork_choice.proto_array.get_block(root_hex) is None:
+            raise GossipValidationError(GossipAction.IGNORE, "parent unknown")
+        block_type, _signed = chain.block_type_at_slot(int(block.slot))
+        block_root = block_type.hash_tree_root(block)
+        if chain.fork_choice.proto_array.has_block("0x" + block_root.hex()):
+            raise GossipValidationError(GossipAction.IGNORE, "already known")
 
 
 # --- sync committee topics ----------------------------------------------------

@@ -17,6 +17,23 @@ import sys
 __all__ = ["main"]
 
 
+def _add_tracing_args(sp) -> None:
+    """Per-slot pipeline tracing flags (lodestar_tpu.tracing), shared by
+    the node-running commands."""
+    sp.add_argument(
+        "--tracing", action="store_true",
+        help="enable per-slot pipeline span tracing (gossip→BLS→STF→fork choice)",
+    )
+    sp.add_argument(
+        "--tracing-slow-slot-ms", type=float, default=2000.0,
+        help="dump any slot trace slower than this as a structured log line",
+    )
+    sp.add_argument(
+        "--tracing-export-dir", default=None,
+        help="write slow-slot traces as Chrome trace_event JSON into this directory",
+    )
+
+
 def _build_parser(with_subparsers: bool = False):
     ap = argparse.ArgumentParser(prog="lodestar-tpu", description="TPU-native beacon chain framework")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -40,6 +57,7 @@ def _build_parser(with_subparsers: bool = False):
     dev.add_argument("--genesis-time", type=int, default=0, help="interop genesis_time (share with peers)")
     dev.add_argument("--linger", type=float, default=0.0, help="keep serving P2P this many seconds after the last slot")
     dev.add_argument("--altair-epoch", type=int, default=None, help="enable the altair fork at this epoch (default: never)")
+    _add_tracing_args(dev)
 
     beacon = sub.add_parser("beacon", help="run a beacon node")
     beacon.add_argument("--db", default=None, help="data directory (default: in-memory)")
@@ -59,6 +77,7 @@ def _build_parser(with_subparsers: bool = False):
         default=None,
         help="trusted beacon API to anchor from (finalized state) instead of a dev genesis",
     )
+    _add_tracing_args(beacon)
 
     val = sub.add_parser("validator", help="run a REST-mode validator client")
     val.add_argument("--beacon-url", default="http://127.0.0.1:9596")
@@ -204,6 +223,9 @@ async def _run_dev(args) -> int:
             manual_clock=True,
             p2p_enabled=p2p,
             p2p_port=args.p2p_port,
+            tracing_enabled=args.tracing,
+            tracing_slow_slot_ms=args.tracing_slow_slot_ms,
+            tracing_export_dir=args.tracing_export_dir,
         ),
         p=p,
         time_fn=lambda: now[0],
@@ -350,6 +372,9 @@ async def _run_beacon(args) -> int:
             p2p_enabled=args.p2p_port != 0 or bool(bootnodes),
             p2p_port=args.p2p_port,
             bootnodes=bootnodes,
+            tracing_enabled=args.tracing,
+            tracing_slow_slot_ms=args.tracing_slow_slot_ms,
+            tracing_export_dir=args.tracing_export_dir,
         ),
         p=p,
         db=db,

@@ -13,6 +13,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from lodestar_tpu import tracing
+
 from .proto_array import (  # noqa: F401
     DEFAULT_PRUNE_THRESHOLD,
     ExecutionStatus,
@@ -172,25 +174,28 @@ class ForkChoice:
 
     def update_head(self) -> str:
         """Recompute and return the canonical head root."""
-        boost = None
-        if self.proposer_boost_root is not None:
-            committee_weight = int(self.justified_balances.sum()) // self.slots_per_epoch
-            boost = (self.proposer_boost_root, committee_weight * PROPOSER_SCORE_BOOST // 100)
-        deltas = compute_deltas(
-            self.proto_array.indices, self.votes, self._old_balances, self.justified_balances
-        )
-        self._old_balances = self.justified_balances
-        self.proto_array.apply_score_changes(
-            deltas=deltas,
-            proposer_boost=boost,
-            justified_epoch=self.justified.epoch,
-            justified_root=self.justified.root,
-            finalized_epoch=self.finalized.epoch,
-            finalized_root=self.finalized.root,
-            current_slot=self.current_slot,
-        )
-        self._head = self.proto_array.find_head(self.justified.root, self.current_slot)
-        return self._head
+        with tracing.span("find_head") as sp:
+            boost = None
+            if self.proposer_boost_root is not None:
+                committee_weight = int(self.justified_balances.sum()) // self.slots_per_epoch
+                boost = (self.proposer_boost_root, committee_weight * PROPOSER_SCORE_BOOST // 100)
+            deltas = compute_deltas(
+                self.proto_array.indices, self.votes, self._old_balances, self.justified_balances
+            )
+            self._old_balances = self.justified_balances
+            self.proto_array.apply_score_changes(
+                deltas=deltas,
+                proposer_boost=boost,
+                justified_epoch=self.justified.epoch,
+                justified_root=self.justified.root,
+                finalized_epoch=self.finalized.epoch,
+                finalized_root=self.finalized.root,
+                current_slot=self.current_slot,
+            )
+            self._head = self.proto_array.find_head(self.justified.root, self.current_slot)
+            if sp:
+                sp.set(nodes=len(self.proto_array.nodes))
+            return self._head
 
     @property
     def head(self) -> str:

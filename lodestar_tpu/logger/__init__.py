@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 
 __all__ = ["LoggerOpts", "get_logger", "get_empty_logger", "LodestarLogger"]
 
-_FORMAT = "%(asctime)s %(levelname)-5s [%(module_tag)s] %(message)s"
+_FORMAT = "%(asctime)s %(levelname)-5s [%(module_tag)s]%(trace_ctx)s %(message)s"
 
 # winston-style names used by the reference map onto stdlib levels
 _LEVEL_ALIASES = {"verbose": "DEBUG", "trace": "DEBUG", "warn": "WARNING", "fatal": "CRITICAL"}
@@ -22,6 +22,26 @@ _LEVEL_ALIASES = {"verbose": "DEBUG", "trace": "DEBUG", "warn": "WARNING", "fata
 
 def _level(name: str) -> str:
     return _LEVEL_ALIASES.get(name.lower(), name.upper())
+
+
+_trace_ctx_fn = None
+
+
+def _trace_ctx() -> str:
+    """' [trace=<id>]' while a pipeline span is active in this context,
+    '' otherwise — log lines emitted inside a traced slot carry its id.
+    Lazy import (cached after first success): the tracing package logs
+    through THIS module, so the dependency must stay one-way at import
+    time; after that every record pays one call + flag check."""
+    global _trace_ctx_fn
+    fn = _trace_ctx_fn
+    if fn is None:
+        try:
+            from lodestar_tpu.tracing import current_log_ctx as fn
+        except Exception:
+            return ""
+        _trace_ctx_fn = fn
+    return fn()
 
 
 class _ModuleTagFilter(logging.Filter):
@@ -32,6 +52,8 @@ class _ModuleTagFilter(logging.Filter):
     def filter(self, record: logging.LogRecord) -> bool:
         if not hasattr(record, "module_tag"):
             record.module_tag = self.tag
+        if not hasattr(record, "trace_ctx"):
+            record.trace_ctx = _trace_ctx()
         return True
 
 

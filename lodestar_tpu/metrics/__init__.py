@@ -28,6 +28,7 @@ from .validator_monitor import ValidatorMonitor
 __all__ = [
     "RegistryMetricCreator",
     "BeaconMetrics",
+    "TraceMetrics",
     "create_metrics",
     "MetricsServer",
     "ValidatorMonitor",
@@ -257,6 +258,19 @@ class ProcessMetrics:
 
 
 @dataclass
+class TraceMetrics:
+    """lodestar_trace_* — span-duration summaries derived from the
+    per-slot pipeline tracer (`lodestar_tpu/tracing`): every completed
+    trace feeds its spans here so the block-pipeline-trace dashboard
+    renders from Prometheus without scraping the debug trace API."""
+
+    span_duration: Histogram  # labeled by span name
+    block_pipeline_time: Histogram  # root-trace (block import) duration
+    traces_completed: Counter
+    slow_slots: Counter
+
+
+@dataclass
 class BeaconMetrics:
     creator: RegistryMetricCreator
     bls_pool: BlsPoolMetrics
@@ -276,6 +290,7 @@ class BeaconMetrics:
     db_detail: "DbDetailMetrics"
     chain: "ChainDetailMetrics"
     process: "ProcessMetrics"
+    trace: "TraceMetrics"
     head_slot: Gauge
     finalized_epoch: Gauge
     justified_epoch: Gauge
@@ -593,6 +608,25 @@ def create_metrics() -> BeaconMetrics:
         ),
         offload_healthy=c.gauge("lodestar_offload_healthy", "Offload channel health bit"),
     )
+    trace = TraceMetrics(
+        span_duration=c.histogram(
+            "lodestar_trace_span_duration_seconds",
+            "Pipeline trace span duration by span name",
+            _SEC_SMALL,
+            ["span"],
+        ),
+        block_pipeline_time=c.histogram(
+            "lodestar_trace_block_pipeline_seconds",
+            "Root block-pipeline trace duration",
+            _SEC_SMALL,
+        ),
+        traces_completed=c.counter(
+            "lodestar_trace_completed_total", "Completed pipeline traces"
+        ),
+        slow_slots=c.counter(
+            "lodestar_trace_slow_slot_total", "Slow-slot trace dumps emitted"
+        ),
+    )
     return BeaconMetrics(
         creator=c,
         bls_pool=bls,
@@ -612,6 +646,7 @@ def create_metrics() -> BeaconMetrics:
         db_detail=db_detail,
         chain=chain,
         process=process,
+        trace=trace,
         head_slot=c.gauge("beacon_head_slot", "Current head slot"),
         finalized_epoch=c.gauge("beacon_finalized_epoch", "Finalized epoch"),
         justified_epoch=c.gauge("beacon_current_justified_epoch", "Justified epoch"),
@@ -638,10 +673,20 @@ class MetricsServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
-                if self.path.rstrip("/") == "/metrics":
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/metrics":
                     body = metrics.scrape()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/healthz":
+                    # liveness probe (k8s-style): the scrape server being
+                    # able to answer at all is the signal
+                    body = b'{"status":"ok"}'
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

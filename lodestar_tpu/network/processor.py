@@ -15,6 +15,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from lodestar_tpu import tracing
 from lodestar_tpu.logger import get_logger
 
 __all__ = ["NetworkProcessor", "GOSSIP_QUEUE_OPTS", "default_gossip_handlers"]
@@ -219,25 +220,31 @@ def default_gossip_handlers(chain) -> dict:
         return await chain.bls.verify_signature_sets(sets)
 
     async def on_block(message, peer):
-        try:
-            validate_gossip_block(chain, message)
-        except GossipValidationError as e:
-            if e.action is GossipAction.REJECT:
-                raise
-            return  # duplicates / future / parent-unknown are benign
-        await chain.process_block(message, is_timely=True)
+        # root span: the whole slot pipeline (gossip validation → BLS →
+        # STF → fork choice) stitches under this one trace
+        with tracing.root("block_import", slot=int(message.message.slot)):
+            try:
+                validate_gossip_block(chain, message)
+            except GossipValidationError as e:
+                tracing.discard()  # no import ran: keep the trace ring real
+                if e.action is GossipAction.REJECT:
+                    raise
+                return  # duplicates / future / parent-unknown are benign
+            await chain.process_block(message, is_timely=True)
 
     async def on_block_and_blobs(message, peer):
         from lodestar_tpu.chain.validation import validate_gossip_block_and_blobs_sidecar
 
-        try:
-            validate_gossip_block_and_blobs_sidecar(chain, message)
-        except GossipValidationError as e:
-            if e.action is GossipAction.REJECT:
-                raise
-            return
-        await chain.process_block(message.beacon_block, is_timely=True)
-        chain.put_blobs_sidecar(message.blobs_sidecar)
+        with tracing.root("block_import", slot=int(message.beacon_block.message.slot)):
+            try:
+                validate_gossip_block_and_blobs_sidecar(chain, message)
+            except GossipValidationError as e:
+                tracing.discard()
+                if e.action is GossipAction.REJECT:
+                    raise
+                return
+            await chain.process_block(message.beacon_block, is_timely=True)
+            chain.put_blobs_sidecar(message.blobs_sidecar)
 
     async def on_attestation(message, peer):
         try:

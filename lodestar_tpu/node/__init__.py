@@ -37,6 +37,9 @@ class BeaconNodeOptions:
         p2p_port: int = 0,
         bootnodes: list[tuple[str, int]] | None = None,
         on_shutdown_request=None,
+        tracing_enabled: bool = False,
+        tracing_slow_slot_ms: float = 2000.0,
+        tracing_export_dir: str | None = None,
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -51,6 +54,10 @@ class BeaconNodeOptions:
         # fatal-error callback (reference ProcessShutdownCallback): the
         # embedding process decides how to die; None = log only
         self.on_shutdown_request = on_shutdown_request
+        # per-slot pipeline tracing (lodestar_tpu.tracing): off by default
+        self.tracing_enabled = tracing_enabled
+        self.tracing_slow_slot_ms = tracing_slow_slot_ms
+        self.tracing_export_dir = tracing_export_dir
 
 
 class BeaconNode:
@@ -119,6 +126,20 @@ class BeaconNode:
         if opts.metrics_enabled:
             metrics_server = MetricsServer(metrics, port=opts.metrics_port)
             metrics_server.start()
+
+        # 2b. pipeline tracing: the span tracer is process-global (the
+        # pipeline crosses layers that never see the node object); only
+        # an explicit opt-in reconfigures it, so embedded/test tracers
+        # set up by the caller are left alone
+        if opts.tracing_enabled:
+            from lodestar_tpu import tracing as _tracing
+
+            _tracing.configure(
+                enabled=True,
+                slow_slot_ms=opts.tracing_slow_slot_ms,
+                export_dir=opts.tracing_export_dir,
+                metrics=metrics.trace,
+            )
 
         # 3. bls verifier
         bls: IBlsVerifier

@@ -24,6 +24,7 @@ import time
 
 import grpc
 
+from lodestar_tpu import tracing
 from lodestar_tpu.chain.bls.interface import IBlsVerifier, VerifySignatureOpts
 from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.logger import get_logger
@@ -123,15 +124,58 @@ class BlsOffloadClient(IBlsVerifier):
         """One RPC per job; blocking stub call moved off the event loop.
         Raises OffloadError on transport/server error (fail closed)."""
         frame = encode_sets(list(sets))
+        n_sets = len(sets)
+        # trace context rides the call's metadata so server-side device
+        # spans come home in trailing metadata and stitch under this RPC;
+        # captured here because the executor thread has no contextvars
+        trace_hdr = tracing.context_header()
+        trace_parent = tracing.current()
 
         def call() -> bool:
+            # clock reads only on the traced path: untraced RPCs pay just
+            # the trace_hdr None-checks
+            t0 = time.monotonic_ns() if trace_hdr is not None else 0
+            grpc_call = None
+            err: str | None = None
             try:
-                verdict = decode_verdict(self._verify(frame, timeout=self.timeout_s))
+                if trace_hdr is not None:
+                    resp, grpc_call = self._verify.with_call(
+                        frame,
+                        timeout=self.timeout_s,
+                        metadata=((tracing.TRACE_CONTEXT_KEY, trace_hdr),),
+                    )
+                else:
+                    resp = self._verify(frame, timeout=self.timeout_s)
+                # may raise OffloadError: the server answered with an
+                # error frame (backend failure) — trailing spans still
+                # came home and must be grafted below
+                verdict = decode_verdict(resp)
                 self._healthy = True
                 return verdict
             except grpc.RpcError as e:
+                err = str(e.code())
                 self._healthy = False  # probe loop takes over reconnection
                 raise OffloadError(f"offload transport: {e.code()}") from e
+            except OffloadError as e:
+                err = str(e)[:120]
+                raise
+            finally:
+                # the RPC span is recorded on EVERY exit path — a failing
+                # slot's trace is exactly the one that needs its offload leg
+                if trace_hdr is not None:
+                    attrs = {"sets": n_sets, "target": self.target}
+                    if err is not None:
+                        attrs["error"] = err
+                    rpc_span = tracing.record(
+                        trace_parent, "offload_rpc", t0, time.monotonic_ns(), attrs
+                    )
+                    if grpc_call is not None:
+                        try:
+                            for k, v in grpc_call.trailing_metadata() or ():
+                                if k == tracing.TRACE_SPANS_KEY:
+                                    tracing.graft_remote_spans(rpc_span, v, t0)
+                        except Exception:
+                            pass  # tracing must never mask the verdict/error
 
         with self._lock:
             self._outstanding += 1

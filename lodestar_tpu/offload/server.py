@@ -17,6 +17,7 @@ from concurrent import futures
 
 import grpc
 
+from lodestar_tpu import tracing
 from lodestar_tpu.logger import get_logger
 
 from . import decode_sets, encode_verdict
@@ -69,13 +70,33 @@ class BlsOffloadServer:
     # -- handlers --------------------------------------------------------------
 
     def _verify(self, request: bytes, context) -> bytes:
+        # caller-propagated trace context: when present, record the
+        # server-side decode/verify spans and ship them back in trailing
+        # metadata so the client grafts them under its RPC span
+        hdr = None
         try:
-            sets = decode_sets(request)
-            ok = bool(self.backend(sets))
-            return encode_verdict(ok)
+            for k, v in context.invocation_metadata() or ():
+                if k == tracing.TRACE_CONTEXT_KEY:
+                    hdr = v
+        except Exception:
+            hdr = None
+        rec = tracing.remote_recorder(hdr)
+        try:
+            with rec.span("offload_decode"):
+                sets = decode_sets(request)
+            with rec.span("offload_device_verify", sets=len(sets)):
+                ok = bool(self.backend(sets))
+            out = encode_verdict(ok)
         except Exception as e:  # error frame, not a transport abort
             self.log.warn("verify job failed", {"error": str(e)})
-            return encode_verdict(None, error=f"{type(e).__name__}: {e}")
+            out = encode_verdict(None, error=f"{type(e).__name__}: {e}")
+        payload = rec.serialize()
+        if payload:
+            try:
+                context.set_trailing_metadata(((tracing.TRACE_SPANS_KEY, payload),))
+            except Exception:
+                pass  # a metadata-less transport must not fail the verdict
+        return out
 
     def _status(self, request: bytes, context) -> bytes:
         return b"\x01" if self._can_accept_work() else b"\x00"

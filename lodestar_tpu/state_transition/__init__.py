@@ -10,6 +10,7 @@ through `ssz` for large states.
 
 from __future__ import annotations
 
+from lodestar_tpu import tracing
 from lodestar_tpu.params import BeaconPreset, active_preset
 from lodestar_tpu.types import ssz_types
 
@@ -96,12 +97,15 @@ def process_slots(state, slot: int, p: BeaconPreset | None = None, cfg=None):
     while state.slot < slot:
         process_slot(state, p)
         if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
-            if fork_of(state) == "phase0":
-                process_epoch(state, EpochContext(state, p), cfg)
-            else:
-                from .altair import process_epoch_altair
+            with tracing.span("epoch_transition") as sp:
+                if sp:
+                    sp.set(epoch=int(state.slot) // p.SLOTS_PER_EPOCH + 1)
+                if fork_of(state) == "phase0":
+                    process_epoch(state, EpochContext(state, p), cfg)
+                else:
+                    from .altair import process_epoch_altair
 
-                process_epoch_altair(state, EpochContext(state, p), cfg)
+                    process_epoch_altair(state, EpochContext(state, p), cfg)
         state.slot += 1
         # scheduled upgrades at the first slot of each activation epoch
         if cfg is not None and state.slot % p.SLOTS_PER_EPOCH == 0:

@@ -14,7 +14,7 @@ reference's main-thread aggregation (`multithread/index.ts:152,177`).
 
 from __future__ import annotations
 
-from lodestar_tpu import ssz
+from lodestar_tpu import ssz, tracing
 from lodestar_tpu.crypto.bls.api import SignatureSet, aggregate_pubkeys
 from lodestar_tpu.params import (
     DOMAIN_BEACON_ATTESTER,
@@ -113,6 +113,7 @@ def voluntary_exit_signature_set(state, signed_exit, ctx: EpochContext) -> Signa
     )
 
 
+@tracing.traced("signature_sets")
 def get_block_signature_sets(
     state,
     signed_block,

@@ -1,0 +1,72 @@
+"""Chrome `trace_event` export for completed pipeline traces.
+
+The output is the Trace Event Format's JSON-object form ("traceEvents"
+array of "ph":"X" complete events, microsecond timestamps) — load it in
+chrome://tracing or https://ui.perfetto.dev unmodified. One process row
+per trace (pid = slot when known), one thread row per originating
+thread, so the BLS executor / offload spans render on their own tracks
+under the slot they belong to.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from . import Span, Trace
+
+__all__ = ["to_chrome_trace", "write_chrome_trace"]
+
+
+def _event(trace: Trace, span: Span, pid: int) -> dict:
+    args = dict(span.attrs or {})
+    args["span_id"] = span.span_id
+    if span.parent_id is not None:
+        args["parent_id"] = span.parent_id
+    end_ns = span.end_ns if span.end_ns is not None else span.start_ns
+    return {
+        "name": span.name,
+        "cat": "lodestar",
+        "ph": "X",
+        "ts": span.start_ns / 1e3,  # trace-event timestamps are in µs
+        "dur": max(0.0, (end_ns - span.start_ns) / 1e3),
+        "pid": pid,
+        "tid": span.tid,
+        "args": args,
+    }
+
+
+def to_chrome_trace(traces: Iterable[Trace]) -> dict:
+    events: list[dict] = []
+    seen_pids: set[int] = set()
+    for i, trace in enumerate(traces):
+        # one process row PER TRACE: competing blocks at the same slot
+        # (short reorg / equivocation) must not merge into one track, so
+        # colliding slots fall back to a synthetic distinct pid
+        pid = trace.slot if trace.slot is not None else 0
+        if pid in seen_pids:
+            pid = 1_000_000 + i  # i is unique per call
+            while pid in seen_pids:
+                pid += 1_000_000
+        seen_pids.add(pid)
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"slot {trace.slot} ({trace.name} {trace.trace_id})"},
+            }
+        )
+        with trace._lock:
+            spans = list(trace.spans)
+        events.extend(
+            _event(trace, s, pid) for s in spans if s.start_ns is not None
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, traces: Iterable[Trace]) -> str:
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(traces), f, indent=1)
+        f.write("\n")
+    return path

@@ -1,0 +1,95 @@
+"""Dashboard consistency: every Prometheus metric name referenced by a
+panel expr in dashboards/*.json must exist in the registry built by
+create_metrics(), and re-running tools/gen_dashboards.py must be a
+no-op against the checked-in JSON."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+import re
+
+from lodestar_tpu.metrics import create_metrics
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+DASHBOARDS = REPO / "dashboards"
+
+# PromQL functions/keywords that survive the identifier regex
+_PROMQL_WORDS = {
+    "histogram_quantile",
+    "label_replace",
+    "label_join",
+    "group_left",
+    "group_right",
+    "count_values",
+}
+
+
+def _registry_sample_names() -> set[str]:
+    """Every sample name the registry can expose. Derived from family
+    name + type (labeled metrics with no observations yet emit no
+    samples, so enumerating family.samples would under-report)."""
+    m = create_metrics()
+    names: set[str] = set()
+    for family in m.creator.registry.collect():
+        n = family.name
+        if family.type == "counter":
+            names.add(n + "_total")
+        elif family.type == "histogram":
+            names.update({n + "_bucket", n + "_sum", n + "_count"})
+        elif family.type == "summary":
+            names.update({n, n + "_sum", n + "_count"})
+        else:
+            names.add(n)
+    return names
+
+
+def _referenced_metric_names() -> set[tuple[str, str]]:
+    refs: set[tuple[str, str]] = set()
+    files = sorted(DASHBOARDS.glob("*.json"))
+    assert len(files) >= 8, "expected the 8 generated dashboards"
+    for path in files:
+        dash = json.loads(path.read_text())
+        for panel in dash["panels"]:
+            for target in panel.get("targets", []):
+                for token in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", target["expr"]):
+                    # metric names in this repo all carry an underscore;
+                    # bare words (by, le, rate, sum, label names) don't
+                    if "_" in token and token not in _PROMQL_WORDS:
+                        refs.add((path.name, token))
+    return refs
+
+
+def test_every_panel_expr_metric_exists_in_registry():
+    names = _registry_sample_names()
+    missing = sorted(
+        (fname, token) for fname, token in _referenced_metric_names() if token not in names
+    )
+    assert not missing, f"dashboard exprs reference unknown metrics: {missing}"
+
+
+def test_trace_dashboard_covers_trace_metrics():
+    dash = json.loads((DASHBOARDS / "lodestar_block_pipeline_trace.json").read_text())
+    exprs = " ".join(
+        t["expr"] for p in dash["panels"] for t in p.get("targets", [])
+    )
+    assert "lodestar_trace_block_pipeline_seconds_bucket" in exprs
+    assert "lodestar_trace_span_duration_seconds" in exprs
+    assert "lodestar_trace_slow_slot_total" in exprs
+
+
+def test_gen_dashboards_regen_is_noop(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "gen_dashboards", REPO / "tools" / "gen_dashboards.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(out=str(tmp_path))
+    generated = sorted(p.name for p in tmp_path.glob("*.json"))
+    checked_in = sorted(p.name for p in DASHBOARDS.glob("*.json"))
+    assert generated == checked_in
+    for name in checked_in:
+        assert (tmp_path / name).read_text() == (DASHBOARDS / name).read_text(), (
+            f"{name} is stale: run `python tools/gen_dashboards.py`"
+        )

@@ -1,0 +1,49 @@
+"""MetricsServer routes: /metrics scrape, /healthz liveness, 404 else."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.metrics import MetricsServer, create_metrics
+
+
+@pytest.fixture()
+def server():
+    srv = MetricsServer(create_metrics(), port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    return urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}", timeout=5)
+
+
+def test_healthz_liveness(server):
+    with _get(server, "/healthz") as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "application/json"
+        assert json.loads(resp.read()) == {"status": "ok"}
+    # trailing slash and query string are tolerated
+    with _get(server, "/healthz/") as resp:
+        assert resp.status == 200
+    with _get(server, "/healthz?probe=1") as resp:
+        assert resp.status == 200
+
+
+def test_metrics_scrape_still_served(server):
+    with _get(server, "/metrics") as resp:
+        assert resp.status == 200
+        body = resp.read().decode()
+    assert "beacon_head_slot" in body
+    assert "lodestar_trace_span_duration_seconds" in body
+
+
+def test_unknown_path_is_404(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/nope")
+    assert ei.value.code == 404

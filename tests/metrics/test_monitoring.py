@@ -1,0 +1,94 @@
+"""MonitoringService: record shape, interval scheduling via the
+injected transport, and failure isolation (a failed push never raises
+into the node)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from lodestar_tpu.metrics.monitoring import VERSION, MonitoringService
+
+
+class _Head:
+    slot = 17
+
+
+class _ProtoArray:
+    def get_block(self, root):
+        return _Head()
+
+
+class _ForkChoice:
+    head = "0x" + "00" * 32
+    proto_array = _ProtoArray()
+
+
+class _Chain:
+    fork_choice = _ForkChoice()
+
+
+def test_collect_record_shape():
+    svc = MonitoringService(endpoint="http://example/api", send_fn=lambda r: None)
+    records = svc.collect()
+    assert isinstance(records, list) and len(records) == 1
+    rec = records[0]
+    assert rec["process"] == "beaconnode"
+    assert rec["client_name"] == "lodestar-tpu"
+    assert rec["client_version"] == VERSION
+    assert rec["version"] == 1
+    assert isinstance(rec["timestamp"], int)
+    assert isinstance(rec["cpu_process_seconds_total"], int)
+    assert isinstance(rec["memory_process_bytes"], int)
+    assert rec["sync_eth2_synced"] is True
+    assert "sync_beacon_head_slot" not in rec  # no chain attached
+
+
+def test_collect_includes_chain_head():
+    svc = MonitoringService(endpoint="x", chain=_Chain(), send_fn=lambda r: None)
+    rec = svc.collect()[0]
+    assert rec["sync_beacon_head_slot"] == 17
+    assert rec["slasher_active"] is False
+
+
+def test_interval_scheduling_with_injected_transport():
+    pushes: list[tuple[float, list]] = []
+
+    def send(records):
+        pushes.append((time.monotonic(), records))
+
+    async def go():
+        svc = MonitoringService(endpoint="x", interval_sec=0.02, send_fn=send)
+        svc.start()
+        svc.start()  # idempotent: one loop task
+        assert svc._task is not None
+        await asyncio.sleep(0.13)
+        await svc.stop()
+        assert svc._task is None
+
+    asyncio.run(go())
+    # ~6 intervals elapsed: at least 3 pushes happened, each a record list
+    assert len(pushes) >= 3
+    for _t, records in pushes:
+        assert records[0]["process"] == "beaconnode"
+    gaps = [b[0] - a[0] for a, b in zip(pushes, pushes[1:])]
+    assert all(g >= 0.015 for g in gaps)  # spaced by the interval, not a busy loop
+
+
+def test_failed_push_never_raises_and_loop_continues():
+    calls = []
+
+    def send(records):
+        calls.append(len(records))
+        if len(calls) == 1:
+            raise RuntimeError("endpoint down")
+
+    async def go():
+        svc = MonitoringService(endpoint="x", interval_sec=0.01, send_fn=send)
+        svc.start()
+        await asyncio.sleep(0.08)
+        # the first push failed; the loop survived and kept pushing
+        await svc.stop()
+
+    asyncio.run(go())  # would raise out of go() if the loop leaked the error
+    assert len(calls) >= 3

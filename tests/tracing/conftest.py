@@ -1,0 +1,12 @@
+"""Tracing tests mutate the process-global tracer; isolate every test."""
+
+import pytest
+
+from lodestar_tpu import tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracing.reset()
+    yield
+    tracing.reset()

@@ -1,0 +1,208 @@
+"""Acceptance: a pool-driven block import (mock verify backend with
+injected delays) produces ONE stitched trace covering gossip validation,
+BLS buffer wait, device launch, state transition and fork choice;
+exports valid Chrome trace_event JSON; triggers exactly one slow-slot
+dump; and with tracing disabled the same pipeline adds no spans."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from lodestar_tpu import params, tracing
+from lodestar_tpu.chain.bls import BlsDeviceVerifierPool
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.metrics import create_metrics
+from lodestar_tpu.network.processor import NetworkProcessor
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+from lodestar_tpu.tracing.export import to_chrome_trace
+
+from ..chain.test_chain import _chain_of_blocks
+
+N = 32
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+@pytest.fixture(scope="module")
+def sks():
+    return interop_secret_keys(N)
+
+
+class DelayBackend:
+    """Mock verify backend: injected device delay on the first launch."""
+
+    def __init__(self, delay_s: float = 0.05):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def __call__(self, sets):
+        self.calls += 1
+        if self.calls == 1:
+            time.sleep(self.delay_s)
+        return True
+
+
+def _pipeline(genesis, backend, slot=2):
+    pool = BlsDeviceVerifierPool(backend, buffer_wait_ms=5)
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=pool,
+        db=MemoryDbController(),
+        current_slot=slot,
+        metrics=create_metrics(),
+    )
+    return chain, pool, NetworkProcessor(chain)
+
+
+def test_block_import_produces_stitched_trace(minimal_preset, sks):
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p)
+    backend = DelayBackend(delay_s=0.05)
+    chain, pool, proc = _pipeline(genesis, backend)
+    blocks = _chain_of_blocks(genesis, sks, p, 2)
+    tracer = tracing.configure(
+        enabled=True, slow_slot_ms=10.0, metrics=chain.metrics.trace
+    )
+
+    async def go():
+        # slot 1 through the gossip pipeline: root trace + slow backend
+        assert proc.push("beacon_block", blocks[0])
+        assert await proc.execute_work() == 1
+        # slot 2: fast backend, threshold not exceeded
+        tracer.slow_slot_ms = 60_000.0
+        assert proc.push("beacon_block", blocks[1])
+        assert await proc.execute_work() == 1
+        await pool.close()
+
+    asyncio.run(go())
+    assert chain.get_head_state().slot == 2
+    assert backend.calls == 2  # one device launch per block's set package
+
+    (trace,) = tracer.traces_for_slot(1)
+    names = {s.name for s in trace.spans}
+    # the stitched slot trace covers every pipeline layer
+    assert {
+        "gossip_validation",
+        "process_block",
+        "pre_state_regen",
+        "bls_verify",
+        "bls_buffer_wait",
+        "bls_device_launch",
+        "state_transition",
+        "hash_tree_root",
+        "persist_block",
+        "fork_choice",
+        "find_head",
+    } <= names
+    assert trace.root.name == "block_import" and trace.slot == 1
+    # the injected device delay is visible on the launch span
+    [launch] = [s for s in trace.spans if s.name == "bls_device_launch"]
+    assert launch.duration_ms >= 50.0
+    # parent/child stitching: every non-root span links to a span in-trace
+    ids = {s.span_id for s in trace.spans}
+    assert all(s.parent_id in ids for s in trace.spans if s is not trace.root)
+    # the cross-thread BLS spans hang off the bls_verify task span
+    [bls_verify] = [s for s in trace.spans if s.name == "bls_verify"]
+    assert launch.parent_id == bls_verify.span_id
+
+    # exactly ONE slow-slot dump: slot 1 exceeded, slot 2 did not
+    assert tracer.slow_slot_dumps == 1
+    assert tracer.last_slow_dump["slot"] == 1
+    assert "bls" in tracer.last_slow_dump["critical_path"]
+
+    # valid Chrome trace_event export
+    doc = json.loads(json.dumps(to_chrome_trace([trace])))
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in events} >= {"gossip_validation", "bls_device_launch"}
+    for e in events:
+        assert e["dur"] >= 0.0 and e["pid"] == 1
+
+    # span durations surfaced into the node's metric registry
+    text = chain.metrics.scrape().decode()
+    assert 'lodestar_trace_span_duration_seconds_count{span="bls_device_launch"}' in text
+    assert "lodestar_trace_slow_slot_total 1.0" in text
+
+    # debug API serves the ring buffer, both span-tree and chrome forms
+    from lodestar_tpu.api.impl import BeaconApiImpl
+    from lodestar_tpu.api.server import _Router
+
+    api = BeaconApiImpl(chain)
+    out = _Router(api).dispatch("GET", "/eth/v0/debug/traces/1", {}, None)
+    assert out["data"][0]["slot"] == 1
+    assert {s["name"] for s in out["data"][0]["spans"]} >= {"bls_verify", "fork_choice"}
+    chrome = _Router(api).dispatch(
+        "GET", "/eth/v0/debug/traces/1", {"format": "chrome"}, None
+    )
+    # unwrapped trace_event document: a curl'd response opens in
+    # chrome://tracing / Perfetto as-is
+    assert "data" not in chrome and chrome["traceEvents"]
+    assert _Router(api).dispatch("GET", "/eth/v0/debug/traces/7", {}, None) == {"data": []}
+    recent = _Router(api).dispatch("GET", "/eth/v0/debug/traces", {"count": "1"}, None)
+    assert [t["slot"] for t in recent["data"]] == [2]  # newest completed trace
+    empty = _Router(api).dispatch("GET", "/eth/v0/debug/traces", {"count": "0"}, None)
+    assert empty == {"data": []}  # count=0 is empty, not the whole ring
+    from lodestar_tpu.api.impl import ApiError
+
+    with pytest.raises(ApiError) as ei:
+        _Router(api).dispatch("GET", "/eth/v0/debug/traces", {"count": "abc"}, None)
+    assert ei.value.status == 400
+
+    # a duplicate (IGNOREd) gossip block runs no pipeline: its trace is
+    # discarded instead of flooding the ring / skewing the histograms
+    completed_before = len(tracer.ring)
+
+    async def replay():
+        assert proc.push("beacon_block", blocks[0])
+        assert await proc.execute_work() == 1
+
+    asyncio.run(replay())
+    assert len(tracer.ring) == completed_before
+    assert len(tracer.traces_for_slot(1)) == 1
+
+    # sync/REST path: a direct duplicate import (ALREADY_KNOWN) is a
+    # no-op too — its trace is discarded just like the gossip IGNORE
+    from lodestar_tpu.chain.chain import BlockError
+
+    async def direct_dup():
+        try:
+            await chain.process_block(blocks[0])
+        except BlockError as e:
+            assert e.code == "ALREADY_KNOWN"
+        else:
+            raise AssertionError("duplicate import must raise")
+
+    asyncio.run(direct_dup())
+    assert len(tracer.ring) == completed_before
+
+
+def test_disabled_pipeline_adds_no_spans(minimal_preset, sks):
+    p = minimal_preset
+    genesis = create_interop_genesis_state(N, p=p)
+    chain, pool, proc = _pipeline(genesis, DelayBackend(delay_s=0.0), slot=1)
+    blocks = _chain_of_blocks(genesis, sks, p, 1)
+    tracer = tracing.get_tracer()
+    assert not tracer.enabled
+
+    async def go():
+        assert proc.push("beacon_block", blocks[0])
+        assert await proc.execute_work() == 1
+        await pool.close()
+
+    asyncio.run(go())
+    assert chain.get_head_state().slot == 1
+    # no trace, no spans, and the instrumented call sites resolved to the
+    # one shared no-op object (nothing allocated beyond the flag check)
+    assert len(tracer.ring) == 0
+    assert tracing.span("state_transition") is tracing.root("block_import")
+    assert "lodestar_trace_completed_total 0.0" in chain.metrics.scrape().decode()

@@ -1,0 +1,136 @@
+"""Offload trace propagation: the client ships its trace context in
+gRPC metadata, the server records device spans and returns them in
+trailing metadata, and the client grafts them under its RPC span."""
+
+from __future__ import annotations
+
+import asyncio
+
+from lodestar_tpu import tracing
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.server import BlsOffloadServer
+
+
+def _dummy_sets(n: int) -> list[SignatureSet]:
+    return [
+        SignatureSet(pubkey=bytes([i]) + bytes(47), message=bytes(32), signature=bytes(96))
+        for i in range(n)
+    ]
+
+
+def test_context_header_roundtrip():
+    tracing.configure(enabled=True)
+    with tracing.root("block_import", slot=42) as sp:
+        hdr = tracing.context_header()
+        assert tracing.parse_context_header(hdr) == (sp.trace.trace_id, sp.span_id, 42)
+    assert tracing.parse_context_header("garbage") is None
+    assert tracing.parse_context_header("") is None
+
+
+def test_remote_recorder_and_graft():
+    rec = tracing.remote_recorder("01:1:5")
+    with rec.span("offload_device_verify", sets=3):
+        pass
+    payload = rec.serialize()
+    assert payload is not None
+    tracing.configure(enabled=True)
+    with tracing.root("block_import", slot=5) as root:
+        import time
+
+        t0 = time.monotonic_ns()
+        rpc = tracing.record(root, "offload_rpc", t0, t0 + 1_000_000)
+        assert tracing.graft_remote_spans(rpc, payload, t0) == 1
+    (trace,) = tracing.get_tracer().traces_for_slot(5)
+    [remote] = [s for s in trace.spans if s.name == "offload_device_verify"]
+    assert remote.attrs["remote"] is True and remote.attrs["sets"] == 3
+    assert remote.parent_id == rpc.span_id
+    # no caller context -> the shared no-op recorder, nothing serialized
+    noop = tracing.remote_recorder(None)
+    with noop.span("x"):
+        pass
+    assert noop.serialize() is None
+    # corrupt payloads graft nothing instead of raising
+    assert tracing.graft_remote_spans(rpc, b"not json", 0) == 0
+
+
+def test_grpc_roundtrip_stitches_server_spans():
+    server = BlsOffloadServer(lambda sets: True, port=0)
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}")
+    tracer = tracing.configure(enabled=True)
+    try:
+
+        async def go():
+            with tracing.root("block_import", slot=3):
+                with tracing.span("bls_verify"):
+                    assert await client.verify_signature_sets(_dummy_sets(2)) is True
+
+        asyncio.run(go())
+        (trace,) = tracer.traces_for_slot(3)
+        names = [s.name for s in trace.spans]
+        assert "offload_rpc" in names
+        # server-side device spans came home and sit under the RPC span
+        [rpc] = [s for s in trace.spans if s.name == "offload_rpc"]
+        remote = [s for s in trace.spans if (s.attrs or {}).get("remote")]
+        assert {s.name for s in remote} == {"offload_decode", "offload_device_verify"}
+        assert all(s.parent_id == rpc.span_id for s in remote)
+        assert all(s.start_ns >= rpc.start_ns for s in remote)
+        assert rpc.attrs["sets"] == 2
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+
+def test_server_error_frame_still_traces_the_rpc():
+    def exploding_backend(sets):
+        raise RuntimeError("device exploded")
+
+    server = BlsOffloadServer(exploding_backend, port=0)
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}")
+    tracer = tracing.configure(enabled=True)
+    try:
+
+        async def go():
+            from lodestar_tpu.offload import OffloadError
+
+            with tracing.root("block_import", slot=9):
+                try:
+                    await client.verify_signature_sets(_dummy_sets(1))
+                except OffloadError as e:
+                    assert "device exploded" in str(e)
+                else:
+                    raise AssertionError("server error frame must fail closed")
+
+        asyncio.run(go())
+        # the failing slot's trace keeps its offload leg: rpc span with
+        # the error attr, plus the server spans from trailing metadata
+        (trace,) = tracer.traces_for_slot(9)
+        [rpc] = [s for s in trace.spans if s.name == "offload_rpc"]
+        assert "device exploded" in rpc.attrs["error"]
+        remote = {s.name for s in trace.spans if (s.attrs or {}).get("remote")}
+        assert "offload_device_verify" in remote
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+
+def test_grpc_without_tracing_stays_bare():
+    server = BlsOffloadServer(lambda sets: True, port=0)
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}")
+    try:
+
+        async def go():
+            # disabled tracer: the plain (no-metadata) call path, and a
+            # traced-looking verify outside any root is equally bare
+            assert await client.verify_signature_sets(_dummy_sets(1)) is True
+            tracing.configure(enabled=True)
+            assert await client.verify_signature_sets(_dummy_sets(1)) is True
+
+        asyncio.run(go())
+        assert len(tracing.get_tracer().ring) == 0  # no orphan traces
+    finally:
+        asyncio.run(client.close())
+        server.stop()

@@ -1,0 +1,254 @@
+"""Tracer core semantics: disabled fast path, parent/child linking,
+asyncio context isolation, ring buffer, slow-slot policy, Chrome export,
+metric derivation, and the logger's %(trace_ctx)s field."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from lodestar_tpu import tracing
+from lodestar_tpu.tracing.export import to_chrome_trace, write_chrome_trace
+
+
+def test_disabled_is_shared_noop_singleton():
+    # the disabled fast path allocates nothing: every call site gets the
+    # same preallocated no-op object back (one flag check)
+    assert tracing.span("a") is tracing.span("b")
+    assert tracing.root("c") is tracing.span("d")
+    assert not tracing.span("a")  # falsy: `if sp:` guards attr-building
+    with tracing.root("block_import", slot=1) as sp:
+        sp.set(anything=1)
+        with tracing.span("child"):
+            pass
+    assert len(tracing.get_tracer().ring) == 0
+    assert tracing.current() is None
+    assert tracing.context_header() is None
+    assert tracing.current_log_ctx() == ""
+
+
+def test_parent_child_linking_and_ring():
+    t = tracing.configure(enabled=True)
+    with tracing.root("block_import", slot=9) as root:
+        with tracing.span("outer") as outer:
+            with tracing.span("inner") as inner:
+                assert tracing.current() is inner
+            assert tracing.current() is outer
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id == root.span_id
+    assert tracing.current() is None
+    (trace,) = t.traces_for_slot(9)
+    assert trace.root.name == "block_import"
+    assert [s.name for s in trace.spans] == ["inner", "outer", "block_import"]
+    assert all(s.end_ns >= s.start_ns for s in trace.spans)
+    # nested root() stitches as a child span instead of splitting a trace
+    with tracing.root("a", slot=10):
+        with tracing.root("b", slot=10):
+            pass
+    assert len(t.traces_for_slot(10)) == 1
+
+
+def test_ring_buffer_bounded():
+    t = tracing.configure(enabled=True, ring_size=4)
+    for slot in range(7):
+        with tracing.root("block_import", slot=slot):
+            pass
+    assert len(t.ring) == 4
+    assert [tr.slot for tr in t.ring] == [3, 4, 5, 6]
+    assert t.traces_for_slot(0) == []
+
+
+def test_asyncio_context_isolation():
+    tracing.configure(enabled=True)
+
+    async def one_import(slot: int):
+        with tracing.root("block_import", slot=slot):
+            with tracing.span("work") as sp:
+                sp.set(slot=slot)
+                await asyncio.sleep(0.01)
+
+    async def go():
+        await asyncio.gather(one_import(1), one_import(2))
+
+    asyncio.run(go())
+    t = tracing.get_tracer()
+    for slot in (1, 2):
+        (trace,) = t.traces_for_slot(slot)
+        work = [s for s in trace.spans if s.name == "work"]
+        assert len(work) == 1
+        assert work[0].attrs == {"slot": slot}
+
+
+def test_explicit_parent_record_for_cross_thread_spans():
+    tracing.configure(enabled=True)
+    with tracing.root("block_import", slot=3) as root:
+        import time
+
+        t0 = time.monotonic_ns()
+        sp = tracing.record(root, "bls_buffer_wait", t0, t0 + 5_000_000, {"sets": 4})
+        assert sp.parent_id == root.span_id
+        assert abs(sp.duration_ms - 5.0) < 1e-9
+    (trace,) = tracing.get_tracer().traces_for_slot(3)
+    assert "bls_buffer_wait" in [s.name for s in trace.spans]
+    # record() against no parent (tracing was off at capture time): no-op
+    assert tracing.record(None, "x", 0, 1) is None
+
+
+def test_slow_slot_dump_exactly_once_with_critical_path():
+    t = tracing.configure(enabled=True, slow_slot_ms=5.0)
+    import time
+
+    with tracing.root("block_import", slot=4):
+        with tracing.span("bls_verify"):
+            with tracing.span("bls_buffer_wait"):
+                time.sleep(0.012)
+        with tracing.span("fork_choice"):
+            pass
+    assert t.slow_slot_dumps == 1  # one trace over threshold -> ONE dump
+    dump = t.last_slow_dump
+    assert dump["slot"] == 4 and dump["duration_ms"] > 5.0
+    # critical path descends into the slowest child chain
+    assert dump["critical_path"].startswith("block_import")
+    assert "bls_verify" in dump["critical_path"]
+    assert "bls_buffer_wait" in dump["critical_path"]
+    assert "fork_choice" not in dump["critical_path"]
+    # a fast trace under the threshold adds no dump
+    t.slow_slot_ms = 60_000.0
+    with tracing.root("block_import", slot=5):
+        pass
+    assert t.slow_slot_dumps == 1
+
+
+def test_discarded_trace_skips_ring_and_metrics():
+    from lodestar_tpu.metrics import create_metrics
+
+    m = create_metrics()
+    t = tracing.configure(enabled=True, slow_slot_ms=0.0, metrics=m.trace)
+    with tracing.root("block_import", slot=13):
+        with tracing.span("gossip_validation"):
+            tracing.discard()  # e.g. duplicate block: IGNORE, no import
+    assert t.traces_for_slot(13) == []
+    assert len(t.ring) == 0
+    assert t.slow_slot_dumps == 0  # even with a 0ms threshold
+    assert "lodestar_trace_completed_total 0.0" in m.scrape().decode()
+    # discard() outside any trace (or disabled) is a no-op
+    tracing.discard()
+    tracing.reset()
+    tracing.discard()
+
+
+def test_chrome_export_valid_trace_event_json(tmp_path):
+    tracing.configure(enabled=True)
+    with tracing.root("block_import", slot=11):
+        with tracing.span("state_transition") as sp:
+            sp.set(epoch=2)
+    (trace,) = tracing.get_tracer().traces_for_slot(11)
+    doc = to_chrome_trace([trace])
+    # the document round-trips as JSON and holds complete events
+    doc = json.loads(json.dumps(doc))
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"block_import", "state_transition"}
+    for e in xs:
+        assert e["pid"] == 11 and e["dur"] >= 0.0 and isinstance(e["ts"], float)
+        assert e["cat"] == "lodestar" and "span_id" in e["args"]
+    [st] = [e for e in xs if e["name"] == "state_transition"]
+    assert st["args"]["epoch"] == 2
+    out = write_chrome_trace(str(tmp_path / "t.json"), [trace])
+    assert json.loads(open(out).read())["traceEvents"]
+
+
+def test_chrome_export_same_slot_traces_get_distinct_pids():
+    # competing blocks at one slot (reorg/equivocation): two ring traces
+    # with the same slot must render as two process tracks, not merge
+    tracing.configure(enabled=True)
+    for _ in range(2):
+        with tracing.root("block_import", slot=33):
+            pass
+    traces = tracing.get_tracer().traces_for_slot(33)
+    assert len(traces) == 2
+    doc = to_chrome_trace(traces)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len({e["pid"] for e in meta}) == 2
+    for tr in traces:  # each track titled with its own trace id
+        assert any(tr.trace_id in e["args"]["name"] for e in meta)
+
+
+def test_slow_slot_export_dir(tmp_path):
+    t = tracing.configure(enabled=True, slow_slot_ms=0.0, export_dir=str(tmp_path))
+    with tracing.root("block_import", slot=21):
+        pass
+    assert t.slow_slot_dumps == 1
+    files = list(tmp_path.glob("slot21_*.json"))
+    assert len(files) == 1
+    assert json.loads(files[0].read_text())["traceEvents"]
+
+
+def test_span_durations_derived_into_metric_registry():
+    from lodestar_tpu.metrics import create_metrics
+
+    m = create_metrics()
+    tracing.configure(enabled=True, slow_slot_ms=0.0, metrics=m.trace)
+    with tracing.root("block_import", slot=8):
+        with tracing.span("bls_verify"):
+            pass
+    text = m.scrape().decode()
+    assert 'lodestar_trace_span_duration_seconds_count{span="bls_verify"} 1.0' in text
+    assert 'lodestar_trace_span_duration_seconds_count{span="block_import"} 1.0' in text
+    assert "lodestar_trace_completed_total 1.0" in text
+    assert "lodestar_trace_slow_slot_total 1.0" in text
+    assert "lodestar_trace_block_pipeline_seconds_count 1.0" in text
+
+
+def test_traced_decorator():
+    calls = []
+
+    @tracing.traced("gossip_validation")
+    def validate(x):
+        calls.append(x)
+        return x * 2
+
+    assert validate(3) == 6  # disabled: passthrough
+    tracing.configure(enabled=True)
+    with tracing.root("block_import", slot=2):
+        assert validate(4) == 8
+    (trace,) = tracing.get_tracer().traces_for_slot(2)
+    assert "gossip_validation" in [s.name for s in trace.spans]
+    assert calls == [3, 4]
+
+
+def test_logger_trace_ctx_field():
+    from lodestar_tpu.logger import _FORMAT, _ModuleTagFilter
+
+    fmt = logging.Formatter(_FORMAT)
+
+    def render(msg: str) -> str:
+        rec = logging.LogRecord("lodestar", logging.INFO, __file__, 1, msg, None, None)
+        _ModuleTagFilter("chain").filter(rec)
+        return fmt.format(rec)
+
+    # tracing off: the field renders empty, format string stays valid
+    assert "[trace=" not in render("quiet")
+    tracing.configure(enabled=True)
+    assert "[trace=" not in render("no active span")
+    with tracing.root("block_import", slot=6) as sp:
+        line = render("inside")
+        assert f"[trace={sp.trace.trace_id}]" in line
+        assert "[chain]" in line
+    assert "[trace=" not in render("after")
+
+
+def test_cli_exposes_tracing_flags():
+    from lodestar_tpu.cli import _build_parser
+
+    ap = _build_parser()
+    args = ap.parse_args(
+        ["beacon", "--tracing", "--tracing-slow-slot-ms", "150",
+         "--tracing-export-dir", "/tmp/traces"]
+    )
+    assert args.tracing is True
+    assert args.tracing_slow_slot_ms == 150.0
+    assert args.tracing_export_dir == "/tmp/traces"
+    dev = ap.parse_args(["dev", "--tracing"])
+    assert dev.tracing is True

@@ -59,7 +59,9 @@ def bls_pool():
             [
                 ("rate(lodestar_bls_thread_pool_sig_sets_started_total[1m])", "started"),
                 ("rate(lodestar_bls_thread_pool_batch_sigs_success_total[1m])", "batch success"),
-                ("rate(lodestar_bls_thread_pool_success_jobs_signature_sets_count[1m])", "success"),
+                # prometheus_client suffixes counters with _total even when
+                # the reference name already ends in _count
+                ("rate(lodestar_bls_thread_pool_success_jobs_signature_sets_count_total[1m])", "success"),
             ],
             unit="ops", x=0, y=0, pid=1,
         ),
@@ -67,7 +69,7 @@ def bls_pool():
             "Jobs started / errors",
             [
                 ("rate(lodestar_bls_thread_pool_jobs_started_total[1m])", "jobs"),
-                ("rate(lodestar_bls_thread_pool_error_jobs_signature_sets_count[1m])", "error sets"),
+                ("rate(lodestar_bls_thread_pool_error_jobs_signature_sets_count_total[1m])", "error sets"),
                 ("rate(lodestar_bls_thread_pool_batch_retries_total[1m])", "batch retries"),
             ],
             unit="ops", x=12, y=0, pid=2,
@@ -266,9 +268,8 @@ def validator_monitor():
     )
 
 
-def main():
-    os.makedirs(OUT, exist_ok=True)
-    for name, dash in (
+def all_dashboards():
+    return (
         ("lodestar_bls_verifier_pool.json", bls_pool()),
         ("lodestar_block_processor.json", block_processor()),
         ("lodestar_networking.json", networking()),
@@ -276,8 +277,14 @@ def main():
         ("lodestar_sync.json", sync_dashboard()),
         ("lodestar_reqresp_api.json", reqresp_api_dashboard()),
         ("lodestar_db.json", db_dashboard()),
-    ):
-        path = os.path.join(OUT, name)
+        ("lodestar_block_pipeline_trace.json", trace_dashboard()),
+    )
+
+
+def main(out: str = OUT):
+    os.makedirs(out, exist_ok=True)
+    for name, dash in all_dashboards():
+        path = os.path.join(out, name)
         with open(path, "w") as f:
             json.dump(dash, f, indent=2)
             f.write("\n")
@@ -413,6 +420,73 @@ def db_dashboard():
         ),
     ]
     return dashboard("lodestar-db", "Lodestar TPU - Database", ps, ["lodestar", "db"])
+
+
+def trace_dashboard():
+    """Per-slot pipeline tracing (lodestar_tpu/tracing): span-duration
+    summaries the tracer derives into the registry, plus the slow-slot
+    dump rate. Slot-level detail lives at /eth/v0/debug/traces/{slot}."""
+    ps = [
+        panel(
+            "Block pipeline duration",
+            [
+                (
+                    "histogram_quantile(0.5, rate(lodestar_trace_block_pipeline_seconds_bucket[5m]))",
+                    "p50",
+                ),
+                (
+                    "histogram_quantile(0.95, rate(lodestar_trace_block_pipeline_seconds_bucket[5m]))",
+                    "p95",
+                ),
+            ],
+            unit="s", pid=1,
+        ),
+        panel(
+            "Span p95 by stage",
+            [
+                (
+                    "histogram_quantile(0.95, sum by (span, le) "
+                    "(rate(lodestar_trace_span_duration_seconds_bucket[5m])))",
+                    "{{span}}",
+                ),
+            ],
+            unit="s", x=12, pid=2,
+        ),
+        panel(
+            "Span time share (sum/s by stage)",
+            [
+                (
+                    "sum by (span) (rate(lodestar_trace_span_duration_seconds_sum[5m]))",
+                    "{{span}}",
+                ),
+            ],
+            unit="s", y=8, pid=3,
+        ),
+        panel(
+            "Traces completed / slow-slot dumps",
+            [
+                ("rate(lodestar_trace_completed_total[5m])", "completed"),
+                ("rate(lodestar_trace_slow_slot_total[5m])", "slow slots"),
+            ],
+            unit="ops", x=12, y=8, pid=4,
+        ),
+        panel(
+            "Span rate by stage",
+            [
+                (
+                    "sum by (span) (rate(lodestar_trace_span_duration_seconds_count[5m]))",
+                    "{{span}}",
+                ),
+            ],
+            unit="ops", y=16, pid=5,
+        ),
+    ]
+    return dashboard(
+        "lodestar-block-pipeline-trace",
+        "Lodestar TPU - Block pipeline trace",
+        ps,
+        ["lodestar", "tracing"],
+    )
 
 
 if __name__ == "__main__":
