@@ -9,9 +9,15 @@ from __future__ import annotations
 
 import asyncio
 
+from lodestar_tpu.chain.bls import VerifySignatureOpts
+from lodestar_tpu.scheduler import PriorityClass
 from lodestar_tpu.ssz.json import from_json, to_json
 from lodestar_tpu.state_transition import EpochContext, compute_epoch_at_slot, process_slots
 from lodestar_tpu.types import ssz_types
+
+# REST-submitted objects verify under the API launch class: behind
+# gossip work, ahead of sync bulk
+_API_VERIFY_OPTS = VerifySignatureOpts(priority=PriorityClass.API)
 
 __all__ = ["BeaconApiImpl", "ApiError"]
 
@@ -244,7 +250,12 @@ class BeaconApiImpl:
         from lodestar_tpu.chain.chain import BlockError
 
         try:
-            self._run_async(self.chain.process_block(signed))
+            # the node's OWN proposal published over REST is the most
+            # deadline-critical block it ever imports — it verifies at
+            # gossip-block priority, not the API bulk class
+            self._run_async(
+                self.chain.process_block(signed, priority=PriorityClass.GOSSIP_BLOCK)
+            )
         except BlockError as e:
             raise ApiError(400, str(e)) from e
         return {}
@@ -294,7 +305,7 @@ class BeaconApiImpl:
                 except GossipValidationError as e:
                     errors.append({"index": i, "message": str(e)})
                     continue
-                if not await self.chain.bls.verify_signature_sets(res.signature_sets):
+                if not await self.chain.bls.verify_signature_sets(res.signature_sets, _API_VERIFY_OPTS):
                     errors.append({"index": i, "message": "invalid attestation signature"})
                     continue
                 import_verified_attestation(self.chain, res, att)
@@ -776,7 +787,7 @@ class BeaconApiImpl:
                         else:
                             last_err = str(e)
                         continue
-                    if not await self.chain.bls.verify_signature_sets(res.signature_sets):
+                    if not await self.chain.bls.verify_signature_sets(res.signature_sets, _API_VERIFY_OPTS):
                         last_err = "invalid signature"
                         break
                     res.register_seen()
@@ -975,7 +986,7 @@ class BeaconApiImpl:
                 except GossipValidationError as e:
                     errors.append({"index": i, "message": str(e)})
                     continue
-                if not await self.chain.bls.verify_signature_sets(res.signature_sets):
+                if not await self.chain.bls.verify_signature_sets(res.signature_sets, _API_VERIFY_OPTS):
                     errors.append({"index": i, "message": "invalid signatures"})
                     continue
                 import_verified_attestation(
@@ -1014,7 +1025,7 @@ class BeaconApiImpl:
                 except GossipValidationError as e:
                     errors.append({"index": i, "message": str(e)})
                     continue
-                if not await self.chain.bls.verify_signature_sets(res.signature_sets):
+                if not await self.chain.bls.verify_signature_sets(res.signature_sets, _API_VERIFY_OPTS):
                     errors.append({"index": i, "message": "invalid signatures"})
                     continue
                 res.register_seen()

@@ -30,10 +30,15 @@ class VerifySignatureOpts:
     critical gossip objects should set it.
     verify_on_main_thread: bypass the pool entirely (cheap single sets on
     the hot path where the job round-trip costs more than the pairing).
+    priority: scheduler launch class (`scheduler.PriorityClass`) carried
+    from the call site — gossip block > gossip attestation > API >
+    range sync > backfill. None means API (the neutral middle class);
+    verifiers without a scheduler ignore it.
     """
 
     batchable: bool = False
     verify_on_main_thread: bool = False
+    priority: "int | None" = None
 
 
 class IBlsVerifier(abc.ABC):

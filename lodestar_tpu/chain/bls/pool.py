@@ -21,6 +21,16 @@ N-worker thread pool replaced by one device pipeline:
 * **Admission** (`index.ts:143-149`): can_accept_work() false once
   MAX_JOBS_CAN_ACCEPT_WORK (512) jobs are outstanding — backpressure
   signal for the gossip processor.
+* **Scheduling** (`lodestar_tpu/scheduler`): launches dequeue through a
+  priority-class queue (gossip block > gossip attestation > API >
+  range sync > backfill; stride-weighted-fair + starvation aging)
+  instead of FIFO, so a slot-deadline block never queues behind a
+  backfill batch. Bulk-class jobs run one per package — the bound on
+  how long they can head-of-line-block an arriving urgent job. Device
+  launches feed an EWMA occupancy tracker (busy-ns/wall-ns) and a
+  graded ACCEPT/SHED_BULK/REJECT admission view the offload server
+  ships to clients. `scheduler_enabled=False` restores arrival order
+  (the control arm for the saturation tests).
 
 The verify backend is injected as a callable (default: the device model
 `models.batch_verify.verify_signature_sets_device`), which keeps the seam
@@ -36,6 +46,14 @@ from typing import Awaitable, Callable, Sequence
 from lodestar_tpu import tracing
 from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.logger import get_logger
+from lodestar_tpu.scheduler import (
+    BULK_CLASSES,
+    AdmissionController,
+    AdmissionState,
+    OccupancyTracker,
+    PriorityClass,
+    PriorityWorkQueue,
+)
 
 from .interface import IBlsVerifier, VerifySignatureOpts
 
@@ -55,6 +73,10 @@ MAX_BUFFERED_SIGS = 32
 MAX_BUFFER_WAIT_MS = 100
 MAX_JOBS_CAN_ACCEPT_WORK = 512
 BATCHABLE_MIN_PER_CHUNK = 16  # worker.ts:11-17
+# sets per launch package under the scheduler: a queued attestation
+# flood must not coalesce into one giant package that head-of-line
+# blocks an arriving gossip block for its whole duration
+MAX_PACKAGE_SETS = 4 * MAX_SIGNATURE_SETS_PER_JOB
 
 
 def chunkify_maximize_chunk_size(arr: Sequence, max_len: int) -> list[list]:
@@ -74,11 +96,17 @@ def chunkify_maximize_chunk_size(arr: Sequence, max_len: int) -> list[list]:
 
 
 class _Job:
-    __slots__ = ("sets", "batchable", "future", "added_ns", "trace_parent")
+    __slots__ = ("sets", "batchable", "priority", "future", "added_ns", "trace_parent")
 
-    def __init__(self, sets: list[SignatureSet], batchable: bool):
+    def __init__(
+        self,
+        sets: list[SignatureSet],
+        batchable: bool,
+        priority: PriorityClass = PriorityClass.API,
+    ):
         self.sets = sets
         self.batchable = batchable
+        self.priority = priority
         self.future: asyncio.Future[bool] = asyncio.get_event_loop().create_future()
         # the submitting task's span (None when tracing is off): the
         # executor thread parents its buffer-wait/device-launch spans on
@@ -95,6 +123,9 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         *,
         buffer_wait_ms: float = MAX_BUFFER_WAIT_MS,
         max_buffered_sigs: int = MAX_BUFFERED_SIGS,
+        scheduler_enabled: bool = True,
+        aging_ms: float | None = None,
+        sched_metrics=None,
     ) -> None:
         if verify_fn is None:
             from lodestar_tpu.models.batch_verify import verify_signature_sets_device
@@ -105,8 +136,29 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         self._max_buffered_sigs = max_buffered_sigs
         self._log = get_logger(name="lodestar.bls-pool")
 
-        self._jobs: asyncio.Queue[_Job] = asyncio.Queue()
+        self.scheduler_enabled = scheduler_enabled
+        self._sched_metrics = sched_metrics
+        queue_kwargs = {"fifo": not scheduler_enabled, "metrics": sched_metrics}
+        if aging_ms is not None:
+            queue_kwargs["aging_ms"] = aging_ms
+        self._jobs: PriorityWorkQueue = PriorityWorkQueue(**queue_kwargs)
+        self.occupancy = OccupancyTracker()
+        self.admission = AdmissionController(
+            self.occupancy,
+            depth_fn=lambda: self._outstanding,
+            shed_bulk_depth=MAX_JOBS_CAN_ACCEPT_WORK // 2,
+            reject_depth=MAX_JOBS_CAN_ACCEPT_WORK,
+            can_accept=lambda: not self._closed,
+        )
         self._outstanding = 0
+        if sched_metrics is not None:
+            # scrape-time evaluation: the EWMA decays on read, so an idle
+            # pool reports decaying occupancy instead of freezing at the
+            # last launch's value
+            sched_metrics.occupancy_permille.set_function(
+                lambda: self.occupancy.occupancy_permille()
+            )
+            sched_metrics.admission_state.set_function(lambda: int(self.admission.state()))
         self._buffered: list[_Job] = []
         self._buffered_sigs = 0
         self._buffer_timer: asyncio.TimerHandle | None = None
@@ -142,9 +194,12 @@ class BlsDeviceVerifierPool(IBlsVerifier):
 
             return verify_signature_sets(sets)
 
+        priority = (
+            PriorityClass(opts.priority) if opts.priority is not None else PriorityClass.API
+        )
         self._ensure_runner()
         jobs = [
-            self._enqueue(_Job(chunk, opts.batchable))
+            self._enqueue(_Job(chunk, opts.batchable, priority))
             for chunk in chunkify_maximize_chunk_size(sets, MAX_SIGNATURE_SETS_PER_JOB)
         ]
         results = await asyncio.gather(*(j.future for j in jobs))
@@ -159,8 +214,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             if not job.future.done():
                 job.future.set_exception(err)
         self._buffered.clear()
-        while not self._jobs.empty():
-            job = self._jobs.get_nowait()
+        for job, _cls, _waited in self._jobs.drain():
             if not job.future.done():
                 job.future.set_exception(err)
         if self._runner is not None:
@@ -191,7 +245,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                     self._buffer_wait_ms / 1000.0, self._flush_buffer
                 )
         else:
-            self._jobs.put_nowait(job)
+            self._jobs.put_nowait(job, job.priority)
         return job
 
     def _dec_outstanding(self) -> None:
@@ -204,17 +258,43 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         jobs, self._buffered = self._buffered, []
         self._buffered_sigs = 0
         for job in jobs:
-            self._jobs.put_nowait(job)
+            self._jobs.put_nowait(job, job.priority)
 
     # -- execution ------------------------------------------------------------
 
+    def _record_sched_dequeue(self, job: _Job, cls: PriorityClass, waited_ns: int) -> None:
+        """`sched_queue_wait` span per traced job: enqueue -> dequeue —
+        the number the saturation acceptance test bounds."""
+        if job.trace_parent is not None:
+            end_ns = time.monotonic_ns()
+            tracing.record(
+                job.trace_parent,
+                "sched_queue_wait",
+                end_ns - waited_ns,
+                end_ns,
+                {"class": cls.label, "sets": len(job.sets)},
+            )
+
     async def _run_jobs(self) -> None:
         while not self._closed:
-            job = await self._jobs.get()
-            # drain whatever is immediately available into one work package
+            job, cls, waited_ns = await self._jobs.get()
+            self._record_sched_dequeue(job, cls, waited_ns)
             package = [job]
-            while not self._jobs.empty():
-                package.append(self._jobs.get_nowait())
+            # drain immediately-available work into the package: same
+            # class only under the scheduler, capped at MAX_PACKAGE_SETS
+            # (and bulk runs ONE job per package) — both bound how long an
+            # arriving gossip block can wait behind the in-flight launch;
+            # everything available in FIFO mode (the pre-scheduler arm)
+            if not (self.scheduler_enabled and cls in BULK_CLASSES):
+                drain_cls = cls if self.scheduler_enabled else None
+                package_sets = len(job.sets)
+                while not self.scheduler_enabled or package_sets < MAX_PACKAGE_SETS:
+                    nxt = self._jobs.get_nowait(drain_cls)
+                    if nxt is None:
+                        break
+                    self._record_sched_dequeue(*nxt)
+                    package.append(nxt[0])
+                    package_sets += len(nxt[0].sets)
             try:
                 await asyncio.get_event_loop().run_in_executor(
                     None, self._verify_package, package
@@ -257,7 +337,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             all_sets = [s for j in chunk for s in j.sets]
             t0 = time.monotonic_ns() if traced else 0
             try:
-                with trace_region("bls_batch_verify"):
+                with trace_region("bls_batch_verify"), self.occupancy.launch():
                     ok = self._verify_fn(all_sets)
             except Exception:
                 self.metrics["batch_retries"] += 1
@@ -278,7 +358,8 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         for j in individual:
             t0 = time.monotonic_ns() if traced else 0
             try:
-                ok = self._verify_fn(j.sets)
+                with self.occupancy.launch():
+                    ok = self._verify_fn(j.sets)
                 if traced:
                     self._trace_launch([j], t0, len(j.sets), "single")
                 self._resolve(j, ok)

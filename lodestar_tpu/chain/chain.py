@@ -26,6 +26,7 @@ from lodestar_tpu.db import Bucket, DbController, Repository
 from lodestar_tpu.fork_choice import Checkpoint, ForkChoice, ProtoBlock
 from lodestar_tpu.logger import get_logger
 from lodestar_tpu.params import BeaconPreset, active_preset
+from lodestar_tpu.scheduler import PriorityClass
 from lodestar_tpu.state_transition import (
     EpochContext,
     compute_epoch_at_slot,
@@ -319,12 +320,17 @@ class BeaconChain:
 
     # -- block import ---------------------------------------------------------
 
-    async def process_block(self, signed_block, *, is_timely: bool = False):
+    async def process_block(self, signed_block, *, is_timely: bool = False, priority=None):
         """Full import pipeline for one gossip/sync block. Serialized
         with other chain mutations via import_lock (REST threads vs the
-        gossip drain loop)."""
+        gossip drain loop). `priority` is the scheduler launch class the
+        block's signature batch carries into the device queue; None maps
+        to GOSSIP_BLOCK when is_timely (slot-deadline gossip import),
+        API otherwise — sync paths pass their own class."""
         with self.import_lock:
-            return await self._process_block_locked(signed_block, is_timely=is_timely)
+            return await self._process_block_locked(
+                signed_block, is_timely=is_timely, priority=priority
+            )
 
     # sanity rejections before any pipeline work — their traces are
     # discarded so no-op imports (sync duplicates) don't flood the ring
@@ -337,12 +343,16 @@ class BeaconChain:
         )
     )
 
-    async def _process_block_locked(self, signed_block, *, is_timely: bool = False):
+    async def _process_block_locked(
+        self, signed_block, *, is_timely: bool = False, priority=None
+    ):
         # root when called directly (sync/REST paths); child span when the
         # gossip processor already opened the slot's block_import trace
         with tracing.root("process_block", slot=int(signed_block.message.slot)):
             try:
-                return await self._process_block_traced(signed_block, is_timely=is_timely)
+                return await self._process_block_traced(
+                    signed_block, is_timely=is_timely, priority=priority
+                )
             except BlockError as e:
                 # the post-verification ALREADY_KNOWN race re-check sets
                 # pipeline_ran: that trace measured real device/STF work
@@ -353,7 +363,11 @@ class BeaconChain:
                     tracing.discard()
                 raise
 
-    async def _process_block_traced(self, signed_block, *, is_timely: bool = False):
+    async def _process_block_traced(
+        self, signed_block, *, is_timely: bool = False, priority=None
+    ):
+        if priority is None:
+            priority = PriorityClass.GOSSIP_BLOCK if is_timely else PriorityClass.API
         t = self.types
         block = signed_block.message
         block_type, signed_type = self.block_type_at_slot(block.slot)
@@ -397,7 +411,7 @@ class BeaconChain:
                 if sp:
                     sp.set(sets=len(sets))
                 return await self.bls.verify_signature_sets(
-                    sets, VerifySignatureOpts(batchable=False)
+                    sets, VerifySignatureOpts(batchable=False, priority=priority)
                 )
 
         sig_task = asyncio.ensure_future(run_sigs())

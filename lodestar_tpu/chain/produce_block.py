@@ -9,6 +9,7 @@ fill in the state root.
 
 from __future__ import annotations
 
+from lodestar_tpu import tracing
 from lodestar_tpu.state_transition import EpochContext, process_block, process_slots
 from lodestar_tpu.types import ssz_types
 
@@ -59,13 +60,37 @@ def produce_block(
     parent_root: bytes | None = None,
 ):
     """Unsigned BeaconBlock proposal for `slot` on the current head
-    (reference `chain.produceBlock` -> produceBlockBody)."""
+    (reference `chain.produceBlock` -> produceBlockBody). Traced as its
+    own root (`block_production` > state advance / op-pool packing /
+    STF+htr) so a missed proposal's latency is attributable; the root
+    carries the device scheduler's occupancy at production start — a
+    proposal that raced a saturated verifier pool says so in its trace."""
+    with tracing.root("block_production", slot=slot) as rsp:
+        if rsp:
+            occ = getattr(chain.bls, "occupancy", None)
+            if occ is not None:
+                rsp.set(sched_occupancy_permille=occ.occupancy_permille())
+        return _produce_block_traced(
+            chain,
+            slot=slot,
+            randao_reveal=randao_reveal,
+            graffiti=graffiti,
+            parent_root=parent_root,
+        )
+
+
+def _produce_block_traced(chain, *, slot, randao_reveal, graffiti, parent_root):
     p = chain.p
     t = ssz_types(p)
     head_root = parent_root if parent_root is not None else chain.head_root
     pre_state = chain.get_state_by_block_root(head_root)
     work = pre_state.copy()
-    ctx = process_slots(work, slot, p, chain.cfg) if slot > work.slot else EpochContext(work, p)
+    with tracing.span("produce_state_advance"):
+        ctx = (
+            process_slots(work, slot, p, chain.cfg)
+            if slot > work.slot
+            else EpochContext(work, p)
+        )
 
     from lodestar_tpu.state_transition.block import block_types_for
 
@@ -75,33 +100,36 @@ def produce_block(
     block.proposer_index = ctx.get_beacon_proposer(slot)
     block.parent_root = head_root
 
-    body = block.body
-    body.randao_reveal = randao_reveal
-    body.graffiti = (graffiti or b"").ljust(32, b"\x00")[:32]
-    eth1 = getattr(chain, "eth1", None)
-    if eth1 is not None:
-        body.eth1_data, deposits = eth1.get_eth1_data_and_deposits(work)
-        body.deposits = deposits[: p.MAX_DEPOSITS]
-    else:
-        body.eth1_data = work.eth1_data
+    with tracing.span("produce_op_pool_packing") as psp:
+        body = block.body
+        body.randao_reveal = randao_reveal
+        body.graffiti = (graffiti or b"").ljust(32, b"\x00")[:32]
+        eth1 = getattr(chain, "eth1", None)
+        if eth1 is not None:
+            body.eth1_data, deposits = eth1.get_eth1_data_and_deposits(work)
+            body.deposits = deposits[: p.MAX_DEPOSITS]
+        else:
+            body.eth1_data = work.eth1_data
 
-    from lodestar_tpu.state_transition.block import fork_of
+        from lodestar_tpu.state_transition.block import fork_of
 
-    if fork_of(work) != "phase0":
-        # sync aggregate over the parent root from the contribution pool;
-        # with no contributions this yields empty bits + the G2 infinity
-        # signature (the eth_fast_aggregate_verify empty-participation case)
-        body.sync_aggregate = chain.sync_contribution_pool.get_sync_aggregate(
-            slot - 1, bytes(head_root)
+        if fork_of(work) != "phase0":
+            # sync aggregate over the parent root from the contribution pool;
+            # with no contributions this yields empty bits + the G2 infinity
+            # signature (the eth_fast_aggregate_verify empty-participation case)
+            body.sync_aggregate = chain.sync_contribution_pool.get_sync_aggregate(
+                slot - 1, bytes(head_root)
+            )
+
+        att_slashings, prop_slashings, exits = chain.op_pool.get_slashings_and_exits(work, p)
+        body.proposer_slashings = prop_slashings
+        body.attester_slashings = att_slashings
+        body.voluntary_exits = exits
+        body.attestations = chain.aggregated_attestation_pool.get_attestations_for_block(
+            work, p, ctx=ctx
         )
-
-    att_slashings, prop_slashings, exits = chain.op_pool.get_slashings_and_exits(work, p)
-    body.proposer_slashings = prop_slashings
-    body.attester_slashings = att_slashings
-    body.voluntary_exits = exits
-    body.attestations = chain.aggregated_attestation_pool.get_attestations_for_block(
-        work, p, ctx=ctx
-    )
+        if psp:
+            psp.set(attestations=len(body.attestations), exits=len(exits))
 
     block.state_root = compute_new_state_root(chain, work, block, ctx)
     return block
@@ -111,5 +139,7 @@ def compute_new_state_root(chain, dialed_state, block, ctx) -> bytes:
     """STF without signature verification, root only (reference
     `computeNewStateRoot.ts` — runs the transition on a throwaway clone)."""
     post = dialed_state.copy()
-    process_block(post, block, ctx, verify_signatures=False, cfg=chain.cfg)
-    return post.type.hash_tree_root(post)
+    with tracing.span("produce_stf"):
+        process_block(post, block, ctx, verify_signatures=False, cfg=chain.cfg)
+    with tracing.span("produce_hash_tree_root"):
+        return post.type.hash_tree_root(post)

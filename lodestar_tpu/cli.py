@@ -32,6 +32,29 @@ def _add_tracing_args(sp) -> None:
         "--tracing-export-dir", default=None,
         help="write slow-slot traces as Chrome trace_event JSON into this directory",
     )
+    sp.add_argument(
+        "--tracing-export-max-files", type=int, default=256,
+        help="keep at most this many exported trace files (oldest pruned; 0 = unlimited)",
+    )
+    sp.add_argument(
+        "--tracing-export-max-age-sec", type=float, default=None,
+        help="prune exported trace files older than this many seconds",
+    )
+
+
+def _add_scheduler_args(sp) -> None:
+    """Device work scheduler + offload flags (lodestar_tpu.scheduler),
+    shared by the node-running commands."""
+    sp.add_argument(
+        "--bls-offload", action="append", default=[], metavar="HOST:PORT",
+        help="route BLS verification to this offload server (repeatable; "
+        "multiple endpoints load-balance by occupancy and admission state)",
+    )
+    sp.add_argument(
+        "--sched-disable", action="store_true",
+        help="disable the priority-aware device work scheduler (FIFO launches; "
+        "debug/comparison only)",
+    )
 
 
 def _build_parser(with_subparsers: bool = False):
@@ -58,6 +81,7 @@ def _build_parser(with_subparsers: bool = False):
     dev.add_argument("--linger", type=float, default=0.0, help="keep serving P2P this many seconds after the last slot")
     dev.add_argument("--altair-epoch", type=int, default=None, help="enable the altair fork at this epoch (default: never)")
     _add_tracing_args(dev)
+    _add_scheduler_args(dev)
 
     beacon = sub.add_parser("beacon", help="run a beacon node")
     beacon.add_argument("--db", default=None, help="data directory (default: in-memory)")
@@ -78,6 +102,7 @@ def _build_parser(with_subparsers: bool = False):
         help="trusted beacon API to anchor from (finalized state) instead of a dev genesis",
     )
     _add_tracing_args(beacon)
+    _add_scheduler_args(beacon)
 
     val = sub.add_parser("validator", help="run a REST-mode validator client")
     val.add_argument("--beacon-url", default="http://127.0.0.1:9596")
@@ -226,6 +251,10 @@ async def _run_dev(args) -> int:
             tracing_enabled=args.tracing,
             tracing_slow_slot_ms=args.tracing_slow_slot_ms,
             tracing_export_dir=args.tracing_export_dir,
+            tracing_export_max_files=args.tracing_export_max_files,
+            tracing_export_max_age_s=args.tracing_export_max_age_sec,
+            offload_endpoints=args.bls_offload,
+            scheduler_enabled=not args.sched_disable,
         ),
         p=p,
         time_fn=lambda: now[0],
@@ -375,6 +404,10 @@ async def _run_beacon(args) -> int:
             tracing_enabled=args.tracing,
             tracing_slow_slot_ms=args.tracing_slow_slot_ms,
             tracing_export_dir=args.tracing_export_dir,
+            tracing_export_max_files=args.tracing_export_max_files,
+            tracing_export_max_age_s=args.tracing_export_max_age_sec,
+            offload_endpoints=args.bls_offload,
+            scheduler_enabled=not args.sched_disable,
         ),
         p=p,
         db=db,

@@ -29,6 +29,7 @@ __all__ = [
     "RegistryMetricCreator",
     "BeaconMetrics",
     "TraceMetrics",
+    "SchedulerMetrics",
     "create_metrics",
     "MetricsServer",
     "ValidatorMonitor",
@@ -258,6 +259,22 @@ class ProcessMetrics:
 
 
 @dataclass
+class SchedulerMetrics:
+    """lodestar_sched_* — the device work scheduler
+    (`lodestar_tpu/scheduler`): per-class launch queue depth/wait/serve
+    counts, starvation-aging promotions, EWMA device occupancy and the
+    graded admission state backing the occupancy dashboard."""
+
+    queue_depth: Gauge  # labeled by launch class
+    queue_wait: Histogram  # labeled by launch class
+    jobs_dequeued: Counter  # labeled by launch class
+    starvation_promotions: Counter
+    occupancy_permille: Gauge
+    admission_state: Gauge  # 0 accept / 1 shed_bulk / 2 reject
+    shed_total: Counter  # labeled by launch class
+
+
+@dataclass
 class TraceMetrics:
     """lodestar_trace_* — span-duration summaries derived from the
     per-slot pipeline tracer (`lodestar_tpu/tracing`): every completed
@@ -291,6 +308,7 @@ class BeaconMetrics:
     chain: "ChainDetailMetrics"
     process: "ProcessMetrics"
     trace: "TraceMetrics"
+    sched: "SchedulerMetrics"
     head_slot: Gauge
     finalized_epoch: Gauge
     justified_epoch: Gauge
@@ -627,6 +645,33 @@ def create_metrics() -> BeaconMetrics:
             "lodestar_trace_slow_slot_total", "Slow-slot trace dumps emitted"
         ),
     )
+    sched = SchedulerMetrics(
+        queue_depth=c.gauge(
+            "lodestar_sched_queue_depth", "Device scheduler queue depth", ["class"]
+        ),
+        queue_wait=c.histogram(
+            "lodestar_sched_queue_wait_seconds",
+            "Launch-queue wait (enqueue to dequeue) by class",
+            _SEC_SMALL,
+            ["class"],
+        ),
+        jobs_dequeued=c.counter(
+            "lodestar_sched_jobs_dequeued_total", "Jobs dequeued for launch", ["class"]
+        ),
+        starvation_promotions=c.counter(
+            "lodestar_sched_starvation_promotions_total",
+            "Jobs served by aging ahead of the fair order",
+        ),
+        occupancy_permille=c.gauge(
+            "lodestar_sched_occupancy_permille", "EWMA device busy-ns per wall-ns (0-1000)"
+        ),
+        admission_state=c.gauge(
+            "lodestar_sched_admission_state", "0 accept / 1 shed bulk / 2 reject"
+        ),
+        shed_total=c.counter(
+            "lodestar_sched_shed_total", "Work deferred by backpressure/admission", ["class"]
+        ),
+    )
     return BeaconMetrics(
         creator=c,
         bls_pool=bls,
@@ -647,6 +692,7 @@ def create_metrics() -> BeaconMetrics:
         chain=chain,
         process=process,
         trace=trace,
+        sched=sched,
         head_slot=c.gauge("beacon_head_slot", "Current head slot"),
         finalized_epoch=c.gauge("beacon_finalized_epoch", "Finalized epoch"),
         justified_epoch=c.gauge("beacon_current_justified_epoch", "Justified epoch"),

@@ -14,9 +14,54 @@ import urllib.request
 
 from lodestar_tpu.logger import get_logger
 
-__all__ = ["MonitoringService"]
+__all__ = ["MonitoringService", "EventLoopLagSampler"]
 
 VERSION = "lodestar-tpu/0.3.0"
+
+
+class EventLoopLagSampler:
+    """Clock-drift sampler behind `ProcessMetrics.event_loop_lag`
+    (reference nodeJsUtil monitorEventLoopDelay analogue): sleep a fixed
+    interval on the loop and observe how late the wakeup lands — the
+    overshoot is exactly the scheduling lag other tasks inflicted. The
+    last sample is also surfaced into slow-slot trace dumps (via
+    `Tracer.lag_ms_supplier`) so a dump distinguishes an event loop
+    starved by Python work from a genuinely slow device pipeline."""
+
+    def __init__(self, histogram=None, *, interval_s: float = 0.5) -> None:
+        self.histogram = histogram
+        self.interval = interval_s
+        self.last_lag_s: float | None = None
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(self.interval)
+            lag = max(0.0, loop.time() - t0 - self.interval)
+            self.last_lag_s = lag
+            if self.histogram is not None:
+                try:
+                    self.histogram.observe(lag)
+                except Exception:
+                    pass  # metric bridge must never kill the sampler
+
+    def last_lag_ms(self) -> float | None:
+        return None if self.last_lag_s is None else self.last_lag_s * 1000.0
 
 
 class MonitoringService:

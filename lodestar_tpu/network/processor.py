@@ -52,6 +52,24 @@ EXECUTE_ORDER = (
 )
 BYPASS_BACKPRESSURE = {"beacon_block", "beacon_block_and_blobs_sidecar"}
 
+# topic -> sched launch-class label for the shed counter: the BLS-bound
+# attestation family sheds verifier work; op-pool topics run the STF
+# locally and count as api-class deferral
+_TOPIC_SHED_CLASS = {
+    topic: (
+        "gossip_attestation"
+        if topic
+        in (
+            "beacon_attestation",
+            "beacon_aggregate_and_proof",
+            "sync_committee",
+            "sync_committee_contribution_and_proof",
+        )
+        else "api"
+    )
+    for topic in GOSSIP_QUEUE_OPTS
+}
+
 
 @dataclass
 class PendingItem:
@@ -135,11 +153,15 @@ class NetworkProcessor:
         priority order; non-block topics stop when the chain is
         backpressured. Returns jobs executed."""
         submitted = 0
+        sched_metrics = getattr(self.metrics, "sched", None)
+        shed_topics: set[str] = set()
         while submitted < max_jobs:
             reason = self._cannot_accept_reason()
             progressed = False
             for topic in EXECUTE_ORDER:
                 if reason is not None and topic not in BYPASS_BACKPRESSURE:
+                    if len(self.queues[topic]):
+                        shed_topics.add(topic)
                     continue
                 handler = self.handlers.get(topic)
                 if handler is None:
@@ -167,6 +189,11 @@ class NetworkProcessor:
                 break  # re-evaluate backpressure + priorities each job
             if not progressed:
                 break
+        if sched_metrics is not None:
+            # once per topic per tick: topics with queued work that
+            # backpressure deferred, labeled by their BLS launch class
+            for topic in shed_topics:
+                sched_metrics.shed_total.labels(_TOPIC_SHED_CLASS[topic]).inc()
         return submitted
 
 
@@ -216,8 +243,15 @@ def default_gossip_handlers(chain) -> dict:
         validate_sync_committee_message,
     )
 
+    from lodestar_tpu.chain.bls import VerifySignatureOpts
+    from lodestar_tpu.scheduler import PriorityClass
+
+    # gossip attestations/aggregates/sync messages share one launch
+    # class: urgent enough to outrank sync bulk, never ahead of a block
+    _ATT_OPTS = VerifySignatureOpts(priority=PriorityClass.GOSSIP_ATTESTATION)
+
     async def _verify(sets) -> bool:
-        return await chain.bls.verify_signature_sets(sets)
+        return await chain.bls.verify_signature_sets(sets, _ATT_OPTS)
 
     async def on_block(message, peer):
         # root span: the whole slot pipeline (gossip validation → BLS →

@@ -40,6 +40,10 @@ class BeaconNodeOptions:
         tracing_enabled: bool = False,
         tracing_slow_slot_ms: float = 2000.0,
         tracing_export_dir: str | None = None,
+        tracing_export_max_files: int = 256,
+        tracing_export_max_age_s: float | None = None,
+        offload_endpoints: list[str] | None = None,
+        scheduler_enabled: bool = True,
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -58,6 +62,14 @@ class BeaconNodeOptions:
         self.tracing_enabled = tracing_enabled
         self.tracing_slow_slot_ms = tracing_slow_slot_ms
         self.tracing_export_dir = tracing_export_dir
+        self.tracing_export_max_files = tracing_export_max_files
+        self.tracing_export_max_age_s = tracing_export_max_age_s
+        # BLS offload endpoints (host:port); non-empty routes the chain's
+        # verifier through BlsOffloadClient with load-aware routing
+        self.offload_endpoints = list(offload_endpoints or [])
+        # device work scheduler (lodestar_tpu.scheduler) for the in-process
+        # pool; False restores FIFO launches (debug/comparison only)
+        self.scheduler_enabled = scheduler_enabled
 
 
 class BeaconNode:
@@ -138,15 +150,35 @@ class BeaconNode:
                 enabled=True,
                 slow_slot_ms=opts.tracing_slow_slot_ms,
                 export_dir=opts.tracing_export_dir,
+                export_max_files=opts.tracing_export_max_files,
+                export_max_age_s=opts.tracing_export_max_age_s,
                 metrics=metrics.trace,
             )
 
+        # 2c. event-loop lag sampler: a fixed-interval sleep whose
+        # overshoot IS the scheduling lag — feeds the (previously
+        # unobserved) lodestar_event_loop_lag_seconds histogram and the
+        # slow-slot dumps, separating loop starvation from device slowness
+        from lodestar_tpu.metrics.monitoring import EventLoopLagSampler
+
+        lag_sampler = EventLoopLagSampler(metrics.process.event_loop_lag)
+        if opts.tracing_enabled:
+            from lodestar_tpu import tracing as _tracing
+
+            _tracing.configure(lag_ms_supplier=lag_sampler.last_lag_ms)
+
         # 3. bls verifier
         bls: IBlsVerifier
-        if opts.use_device_verifier:
+        if opts.offload_endpoints:
+            from lodestar_tpu.offload.client import BlsOffloadClient
+
+            bls = BlsOffloadClient(opts.offload_endpoints)
+        elif opts.use_device_verifier:
             from lodestar_tpu.chain.bls import BlsDeviceVerifierPool
 
-            bls = BlsDeviceVerifierPool()
+            bls = BlsDeviceVerifierPool(
+                scheduler_enabled=opts.scheduler_enabled, sched_metrics=metrics.sched
+            )
         else:
             bls = BlsSingleThreadVerifier()
 
@@ -191,7 +223,7 @@ class BeaconNode:
         chain.loop = _asyncio.get_running_loop()
         from lodestar_tpu.network.processor import NetworkProcessor
 
-        processor = NetworkProcessor(chain)
+        processor = NetworkProcessor(chain, metrics=metrics)
 
         # 7. REST API
         rest_server = None
@@ -212,9 +244,11 @@ class BeaconNode:
         node.fault = ProcessFaultPolicy(opts.on_shutdown_request)
         chain.fault = node.fault
         node.notifier = StatusNotifier(chain)
+        node.lag_sampler = lag_sampler
         if not opts.manual_clock:
             clock.on_slot(node.notifier.on_slot)
             node.start_gossip_drain()
+            lag_sampler.start()
 
         # 8. P2P network (TCP + noise + mplex + gossipsub + reqresp)
         if opts.p2p_enabled:
@@ -254,6 +288,8 @@ class BeaconNode:
             self._drain_task = None
         if self.rest_server is not None:
             self.rest_server.stop()
+        if getattr(self, "lag_sampler", None) is not None:
+            await self.lag_sampler.stop()
         await self.clock.stop()
         await self.bls.close()
         if self.metrics_server is not None:

@@ -17,22 +17,39 @@ owns the accelerator. This package is that boundary:
 Wire format (framed, no codegen needed — grpc carries opaque bytes):
   request:  u32le count || count * (pubkey48 || message32 || signature96)
   response: u8 ok(1)/invalid(0)/error(2) || error utf-8
+  status:   u8 can_accept || 0xA5 || u8 version ||
+            u8 admission(0 accept/1 shed_bulk/2 reject) ||
+            u16le occupancy_permille || u32le queue_depth
+            (legacy servers reply with the bare can_accept byte; legacy
+            clients read byte 0 of the new frame and see exactly the old
+            binary gate — both directions stay compatible)
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.scheduler import AdmissionState
 
 __all__ = [
     "encode_sets",
     "decode_sets",
     "encode_verdict",
     "decode_verdict",
+    "encode_status",
+    "decode_status",
+    "StatusFrame",
     "OffloadError",
     "SET_BYTES",
+    "STATUS_FRAME_BYTES",
 ]
 
 SET_BYTES = 48 + 32 + 96
+
+STATUS_MAGIC = 0xA5
+STATUS_VERSION = 1
+STATUS_FRAME_BYTES = 10
 
 
 class OffloadError(Exception):
@@ -64,6 +81,60 @@ def decode_sets(data: bytes) -> list[SignatureSet]:
         sets.append(SignatureSet(pubkey=pk, message=msg, signature=sig))
         off += SET_BYTES
     return sets
+
+
+@dataclass(frozen=True)
+class StatusFrame:
+    """Decoded Status reply. `extended=False` means the server spoke the
+    legacy single-byte protocol: occupancy/queue depth are unknown and
+    admission is synthesized from the binary gate."""
+
+    can_accept: bool
+    admission: AdmissionState
+    occupancy_permille: int | None = None
+    queue_depth: int | None = None
+    extended: bool = False
+
+
+def encode_status(
+    *, occupancy_permille: int, queue_depth: int, admission: AdmissionState | int
+) -> bytes:
+    adm = AdmissionState(admission)
+    occ = max(0, min(1000, int(occupancy_permille)))
+    depth = max(0, min(0xFFFFFFFF, int(queue_depth)))
+    return (
+        bytes([0 if adm is AdmissionState.REJECT else 1, STATUS_MAGIC, STATUS_VERSION, adm])
+        + occ.to_bytes(2, "little")
+        + depth.to_bytes(4, "little")
+    )
+
+
+def decode_status(data: bytes) -> StatusFrame:
+    if not data:
+        raise OffloadError("empty status frame")
+    can_accept = data[0] == 1
+    if (
+        len(data) >= STATUS_FRAME_BYTES
+        and data[1] == STATUS_MAGIC
+        and data[2] == STATUS_VERSION
+    ):
+        try:
+            admission = AdmissionState(data[3])
+        except ValueError:
+            admission = AdmissionState.ACCEPT if can_accept else AdmissionState.REJECT
+        return StatusFrame(
+            can_accept=can_accept,
+            admission=admission,
+            occupancy_permille=int.from_bytes(data[4:6], "little"),
+            queue_depth=int.from_bytes(data[6:10], "little"),
+            extended=True,
+        )
+    # legacy single-byte reply (or an unknown future version's prefix:
+    # byte 0 keeps the binary-gate meaning in every version)
+    return StatusFrame(
+        can_accept=can_accept,
+        admission=AdmissionState.ACCEPT if can_accept else AdmissionState.REJECT,
+    )
 
 
 def encode_verdict(ok: bool | None, error: str = "") -> bytes:

@@ -8,12 +8,20 @@ import rejects, nothing ever resolves valid on error (reference
 
 Admission control is LOCAL (r5 hardening, VERDICT r4 weak #5): the hot
 path's `can_accept_work` reads an in-process outstanding-job counter and
-a cached health bit — the reference's jobsWorkers counter semantics
-(`multithread/index.ts:143-149`, MAX_JOBS) — instead of issuing a
-blocking Status RPC per gossip batch. Health is refreshed by a
+cached per-endpoint health — the reference's jobsWorkers counter
+semantics (`multithread/index.ts:143-149`, MAX_JOBS) — instead of
+issuing a blocking Status RPC per gossip batch. Health is refreshed by a
 background probe, and a failed channel is re-dialed with exponential
 backoff, so a restarted offload server is picked back up without
 operator action.
+
+Multi-endpoint routing: `target` may be one `host:port` or a list. The
+probe decodes each server's occupancy Status frame (`decode_status`;
+legacy single-byte servers still parse) and every job routes by launch
+class — bulk classes (range sync, backfill) avoid SHED_BULK endpoints,
+everything avoids REJECT, and ties break toward the least-occupied
+server. One saturated host therefore sheds its backfill traffic onto an
+idle peer while gossip keeps flowing to both.
 """
 
 from __future__ import annotations
@@ -28,8 +36,9 @@ from lodestar_tpu import tracing
 from lodestar_tpu.chain.bls.interface import IBlsVerifier, VerifySignatureOpts
 from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.logger import get_logger
+from lodestar_tpu.scheduler import BULK_CLASSES, AdmissionState, PriorityClass
 
-from . import OffloadError, decode_verdict, encode_sets
+from . import OffloadError, decode_status, decode_verdict, encode_sets
 from .server import STATUS_METHOD, VERIFY_METHOD
 
 __all__ = ["BlsOffloadClient"]
@@ -39,31 +48,79 @@ MAX_OUTSTANDING_JOBS = 512  # reference MAX_JOBS (`multithread/index.ts:62`)
 HEALTH_PROBE_INTERVAL_S = 2.0
 RECONNECT_BACKOFF_S = (0.5, 1.0, 2.0, 4.0, 8.0)  # then stays at the max
 
+_UNKNOWN_OCCUPANCY = 500  # rank servers that never reported between idle and pinned
+
 
 def _identity(b: bytes) -> bytes:
     return b
 
 
+class _Endpoint:
+    """One server: channel + stubs + probe-refreshed load/health state."""
+
+    __slots__ = (
+        "target",
+        "channel",
+        "verify",
+        "status",
+        "healthy",
+        "consecutive_failures",
+        "outstanding",
+        "occupancy_permille",
+        "queue_depth",
+        "admission",
+        "extended",
+    )
+
+    def __init__(self, target: str):
+        self.target = target
+        self.channel = None
+        self.verify = None
+        self.status = None
+        self.healthy = True  # optimistic until the first probe
+        self.consecutive_failures = 0
+        self.outstanding = 0
+        self.occupancy_permille: int | None = None
+        self.queue_depth: int | None = None
+        self.admission = AdmissionState.ACCEPT
+        self.extended = False
+
+    def state(self) -> dict:
+        return {
+            "target": self.target,
+            "healthy": self.healthy,
+            "outstanding": self.outstanding,
+            "occupancy_permille": self.occupancy_permille,
+            "queue_depth": self.queue_depth,
+            "admission": self.admission.label,
+            "extended": self.extended,
+        }
+
+
 class BlsOffloadClient(IBlsVerifier):
     def __init__(
         self,
-        target: str,
+        target: str | list[str] | tuple[str, ...],
         *,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         max_outstanding: int = MAX_OUTSTANDING_JOBS,
         probe_interval_s: float = HEALTH_PROBE_INTERVAL_S,
     ) -> None:
-        self.target = target
+        targets = [target] if isinstance(target, str) else list(target)
+        if not targets:
+            raise ValueError("at least one offload target required")
+        self.target = targets[0]  # primary, kept for single-endpoint callers
+        self.targets = targets
         self.timeout_s = timeout_s
         self.max_outstanding = max_outstanding
         self.probe_interval_s = probe_interval_s
         self.log = get_logger(name="lodestar.offload.client")
         self._lock = threading.Lock()
         self._outstanding = 0
-        self._healthy = True  # optimistic until the first probe
-        self._consecutive_failures = 0
         self._closed = False
-        self._connect()
+        self._endpoints = [_Endpoint(t) for t in targets]
+        for ep in self._endpoints:
+            self._connect(ep)
         self._probe_thread = threading.Thread(
             target=self._probe_loop, name="offload-health-probe", daemon=True
         )
@@ -71,50 +128,117 @@ class BlsOffloadClient(IBlsVerifier):
 
     # -- channel lifecycle ----------------------------------------------------
 
-    def _connect(self) -> None:
-        self._channel = grpc.insecure_channel(self.target)
-        self._verify = self._channel.unary_unary(
+    def _connect(self, ep: _Endpoint) -> None:
+        ep.channel = grpc.insecure_channel(ep.target)
+        ep.verify = ep.channel.unary_unary(
             VERIFY_METHOD, request_serializer=_identity, response_deserializer=_identity
         )
-        self._status = self._channel.unary_unary(
+        ep.status = ep.channel.unary_unary(
             STATUS_METHOD, request_serializer=_identity, response_deserializer=_identity
         )
 
-    def _reconnect(self) -> None:
+    def _reconnect(self, ep: _Endpoint) -> None:
         try:
-            self._channel.close()
+            ep.channel.close()
         except Exception:
             pass
-        self._connect()
+        self._connect(ep)
+
+    def _probe_one(self, ep: _Endpoint) -> bool:
+        """One Status probe. Returns False only on TRANSPORT failure —
+        a live server reporting REJECT is unhealthy for routing purposes
+        (ep.healthy False) but its channel is fine: no reconnect, no
+        backoff, keep probing at the normal cadence so recovery from a
+        transient occupancy spike is noticed within one interval. Probes
+        run serially on the one probe thread, so the timeout tracks the
+        probe interval — a blackholed endpoint delays its siblings'
+        refresh by at most one short timeout, not a full 2s."""
+        timeout = min(2.0, max(0.5, self.probe_interval_s))
+        try:
+            out = ep.status(b"", timeout=timeout)
+            frame = decode_status(out)
+        except (grpc.RpcError, OffloadError):
+            ep.healthy = False
+            return False
+        # transport up; the binary gate keeps the old health semantics
+        # (a server that REJECTs everything counts as not-accepting)
+        if not ep.healthy and frame.can_accept:
+            self.log.info(f"offload service {ep.target} is back")
+        ep.healthy = frame.can_accept
+        ep.admission = frame.admission
+        ep.occupancy_permille = frame.occupancy_permille
+        ep.queue_depth = frame.queue_depth
+        ep.extended = frame.extended
+        return True
 
     def _probe_loop(self) -> None:
-        """Background health probe + reconnect-with-backoff. Runs in its
-        own thread so the asyncio loop and the hot path never wait on it."""
+        """Background status probe + reconnect-with-backoff. Runs in its
+        own thread so the asyncio loop and the hot path never wait on it.
+        Each endpoint keeps its OWN next-probe deadline — healthy ones
+        refresh every probe_interval_s, failed ones back off individually
+        — so one dead endpoint's probe timeouts neither stall the healthy
+        endpoints' occupancy refresh nor get re-dialed ahead of their
+        backoff."""
+        # indexed by endpoint position: duplicate targets stay independent
+        next_at = [0.0] * len(self._endpoints)
         while not self._closed:
-            try:
-                out = self._status(b"", timeout=2.0)
-                ok = bool(out and out[0] == 1)
-            except grpc.RpcError:
-                ok = False
-            if ok:
-                if not self._healthy:
-                    self.log.info(f"offload service {self.target} is back")
-                self._healthy = True
-                self._consecutive_failures = 0
-                time.sleep(self.probe_interval_s)
-            else:
-                self._healthy = False
-                idx = min(self._consecutive_failures, len(RECONNECT_BACKOFF_S) - 1)
-                delay = RECONNECT_BACKOFF_S[idx]
-                self._consecutive_failures += 1
-                time.sleep(delay)
-                if self._closed:
-                    return
-                # never tear down a channel with verifications in flight:
-                # a transient probe timeout must not abort valid work —
-                # in-flight RPCs fail (or succeed) on their own merits
-                if self._outstanding == 0:
-                    self._reconnect()
+            now = time.monotonic()
+            for i, ep in enumerate(self._endpoints):
+                if now < next_at[i]:
+                    continue
+                if self._probe_one(ep):
+                    ep.consecutive_failures = 0
+                    next_at[i] = time.monotonic() + self.probe_interval_s
+                else:
+                    idx = min(ep.consecutive_failures, len(RECONNECT_BACKOFF_S) - 1)
+                    ep.consecutive_failures += 1
+                    if self._closed:
+                        return
+                    # never tear down a channel with verifications in
+                    # flight: a transient probe timeout must not abort
+                    # valid work — in-flight RPCs fail (or succeed) on
+                    # their own merits
+                    if ep.outstanding == 0:
+                        self._reconnect(ep)
+                    next_at[i] = time.monotonic() + RECONNECT_BACKOFF_S[idx]
+            if self._closed:
+                return
+            wake = min(next_at) - time.monotonic()
+            time.sleep(min(self.probe_interval_s, max(0.02, wake)))
+
+    # -- routing ---------------------------------------------------------------
+
+    def _pick_endpoint(self, priority: PriorityClass) -> _Endpoint:
+        """Least-occupied healthy endpoint whose admission state admits
+        this class; bulk work skips SHED_BULK servers while any endpoint
+        still ACCEPTs. Degrades to any-healthy, then to the primary (the
+        verify RPC then fails closed on its own)."""
+        with self._lock:
+            eps = self._endpoints
+            if len(eps) == 1:
+                return eps[0]
+            healthy = [ep for ep in eps if ep.healthy]
+            cands = [ep for ep in healthy if ep.admission is not AdmissionState.REJECT]
+            if priority in BULK_CLASSES:
+                accepting = [ep for ep in cands if ep.admission is AdmissionState.ACCEPT]
+                if accepting:
+                    cands = accepting
+            if not cands:
+                cands = healthy or eps
+            return min(
+                cands,
+                key=lambda ep: (
+                    ep.occupancy_permille
+                    if ep.occupancy_permille is not None
+                    else _UNKNOWN_OCCUPANCY,
+                    ep.outstanding,
+                ),
+            )
+
+    def endpoint_states(self) -> list[dict]:
+        """Probe-refreshed view per endpoint (debugging/metrics/tests)."""
+        with self._lock:
+            return [ep.state() for ep in self._endpoints]
 
     # -- IBlsVerifier ----------------------------------------------------------
 
@@ -125,6 +249,12 @@ class BlsOffloadClient(IBlsVerifier):
         Raises OffloadError on transport/server error (fail closed)."""
         frame = encode_sets(list(sets))
         n_sets = len(sets)
+        priority = (
+            PriorityClass(opts.priority)
+            if opts is not None and opts.priority is not None
+            else PriorityClass.API
+        )
+        ep = self._pick_endpoint(priority)
         # trace context rides the call's metadata so server-side device
         # spans come home in trailing metadata and stitch under this RPC;
         # captured here because the executor thread has no contextvars
@@ -139,22 +269,22 @@ class BlsOffloadClient(IBlsVerifier):
             err: str | None = None
             try:
                 if trace_hdr is not None:
-                    resp, grpc_call = self._verify.with_call(
+                    resp, grpc_call = ep.verify.with_call(
                         frame,
                         timeout=self.timeout_s,
                         metadata=((tracing.TRACE_CONTEXT_KEY, trace_hdr),),
                     )
                 else:
-                    resp = self._verify(frame, timeout=self.timeout_s)
+                    resp = ep.verify(frame, timeout=self.timeout_s)
                 # may raise OffloadError: the server answered with an
                 # error frame (backend failure) — trailing spans still
                 # came home and must be grafted below
                 verdict = decode_verdict(resp)
-                self._healthy = True
+                ep.healthy = True
                 return verdict
             except grpc.RpcError as e:
                 err = str(e.code())
-                self._healthy = False  # probe loop takes over reconnection
+                ep.healthy = False  # probe loop takes over reconnection
                 raise OffloadError(f"offload transport: {e.code()}") from e
             except OffloadError as e:
                 err = str(e)[:120]
@@ -163,7 +293,11 @@ class BlsOffloadClient(IBlsVerifier):
                 # the RPC span is recorded on EVERY exit path — a failing
                 # slot's trace is exactly the one that needs its offload leg
                 if trace_hdr is not None:
-                    attrs = {"sets": n_sets, "target": self.target}
+                    attrs = {
+                        "sets": n_sets,
+                        "target": ep.target,
+                        "class": priority.label,
+                    }
                     if err is not None:
                         attrs["error"] = err
                     rpc_span = tracing.record(
@@ -179,18 +313,28 @@ class BlsOffloadClient(IBlsVerifier):
 
         with self._lock:
             self._outstanding += 1
+            ep.outstanding += 1
         try:
             return await asyncio.get_event_loop().run_in_executor(None, call)
         finally:
             with self._lock:
                 self._outstanding -= 1
+                ep.outstanding -= 1
 
     def can_accept_work(self) -> bool:
         """RPC-free admission: in-process outstanding-job counter below the
-        cap AND the cached health bit (background probe). Sheds load
-        rather than queueing against a dead or saturated service."""
-        return self._healthy and self._outstanding < self.max_outstanding
+        cap AND some endpoint's cached health (background probe). Sheds
+        load rather than queueing against dead or saturated services. The
+        cap is per endpoint (reference MAX_JOBS per pool), so adding
+        offload servers adds admitted concurrency."""
+        if self._outstanding >= self.max_outstanding * len(self._endpoints):
+            return False
+        return any(ep.healthy for ep in self._endpoints)
 
     async def close(self) -> None:
         self._closed = True
-        self._channel.close()
+        for ep in self._endpoints:
+            try:
+                ep.channel.close()
+            except Exception:
+                pass

@@ -4,7 +4,13 @@ Exposes the verify backend over gRPC generic handlers (opaque-bytes
 methods — no proto codegen needed in this environment):
 
   /lodestar.BlsOffload/VerifySignatureSets   sets frame -> verdict frame
-  /lodestar.BlsOffload/Status                b"" -> u8 can_accept_work
+  /lodestar.BlsOffload/Status                b"" -> occupancy status frame
+
+Status grades the old binary can-accept byte into an occupancy frame
+(EWMA busy-ns/wall-ns around device launches, in-flight depth, and an
+ACCEPT/SHED_BULK/REJECT admission state) so a multi-endpoint client can
+prefer the least-occupied host and keep bulk work off a shedding one.
+Byte 0 keeps the legacy meaning — old clients read it unchanged.
 
 Run standalone (`python -m lodestar_tpu.offload.server`) next to the
 TPU, with beacon nodes connecting via `client.BlsOffloadClient` over
@@ -13,14 +19,16 @@ DCN (SURVEY §2d).
 
 from __future__ import annotations
 
+import threading
 from concurrent import futures
 
 import grpc
 
 from lodestar_tpu import tracing
 from lodestar_tpu.logger import get_logger
+from lodestar_tpu.scheduler import AdmissionController, OccupancyTracker
 
-from . import decode_sets, encode_verdict
+from . import decode_sets, encode_status, encode_verdict
 
 __all__ = ["BlsOffloadServer", "SERVICE_NAME", "VERIFY_METHOD", "STATUS_METHOD"]
 
@@ -37,8 +45,11 @@ class BlsOffloadServer:
     """gRPC host around a verify backend.
 
     backend(sets) -> bool may be sync or return an awaitable-free bool;
-    can_accept_work() -> bool gates admission (mirrors the pool's
-    MAX_JOBS semantics when the backend is a BlsDeviceVerifierPool)."""
+    can_accept_work() -> bool stays the hard veto (mirrors the pool's
+    MAX_JOBS semantics when the backend is a BlsDeviceVerifierPool);
+    on top of it the server tracks per-launch occupancy and grades
+    admission — injectable `admission` (anything with .state()) lets
+    tests and smarter hosts replace the policy."""
 
     def __init__(
         self,
@@ -48,9 +59,30 @@ class BlsOffloadServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_workers: int = 4,
+        occupancy_tracker: OccupancyTracker | None = None,
+        admission=None,
+        shed_bulk_at: float = 0.75,
+        reject_at: float = 0.95,
     ) -> None:
         self.backend = backend
         self._can_accept_work = can_accept_work or (lambda: True)
+        self.occupancy = occupancy_tracker or OccupancyTracker()
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self.admission = admission or AdmissionController(
+            self.occupancy,
+            shed_bulk_at=shed_bulk_at,
+            reject_at=reject_at,
+            depth_fn=lambda: self._pending,
+            # _pending counts RPCs already ON the gRPC worker threads —
+            # the executor queues the rest invisibly, so it never exceeds
+            # max_workers. All-workers-busy is therefore the depth signal
+            # for SHED_BULK; deeper backlog surfaces as occupancy, which
+            # alone drives REJECT (depth-based REJECT is unreachable)
+            shed_bulk_depth=max(1, max_workers),
+            reject_depth=1 << 30,
+            can_accept=self._can_accept_work,
+        )
         self.log = get_logger(name="lodestar.offload")
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         handlers = {
@@ -81,15 +113,21 @@ class BlsOffloadServer:
         except Exception:
             hdr = None
         rec = tracing.remote_recorder(hdr)
+        with self._pending_lock:
+            self._pending += 1
         try:
             with rec.span("offload_decode"):
                 sets = decode_sets(request)
             with rec.span("offload_device_verify", sets=len(sets)):
-                ok = bool(self.backend(sets))
+                with self.occupancy.launch():
+                    ok = bool(self.backend(sets))
             out = encode_verdict(ok)
         except Exception as e:  # error frame, not a transport abort
             self.log.warn("verify job failed", {"error": str(e)})
             out = encode_verdict(None, error=f"{type(e).__name__}: {e}")
+        finally:
+            with self._pending_lock:
+                self._pending -= 1
         payload = rec.serialize()
         if payload:
             try:
@@ -99,7 +137,11 @@ class BlsOffloadServer:
         return out
 
     def _status(self, request: bytes, context) -> bytes:
-        return b"\x01" if self._can_accept_work() else b"\x00"
+        return encode_status(
+            occupancy_permille=self.occupancy.occupancy_permille(),
+            queue_depth=self._pending,
+            admission=self.admission.state(),
+        )
 
     # -- lifecycle -------------------------------------------------------------
 

@@ -14,6 +14,7 @@ from lodestar_tpu.chain.bls import IBlsVerifier, VerifySignatureOpts
 from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.logger import get_logger
 from lodestar_tpu.params import DOMAIN_BEACON_PROPOSER, active_preset
+from lodestar_tpu.scheduler import PriorityClass
 
 __all__ = ["BackfillSync", "BackfillError"]
 
@@ -90,7 +91,8 @@ class BackfillSync:
             # (b) proposer signatures: one batch for the whole segment
             sets = [self._proposer_set(signed, t, p) for signed in blocks]
             if sets and not await self.bls.verify_signature_sets(
-                sets, VerifySignatureOpts(batchable=False)
+                sets,
+                VerifySignatureOpts(batchable=False, priority=PriorityClass.BACKFILL),
             ):
                 raise BackfillError("segment proposer-signature batch invalid")
 

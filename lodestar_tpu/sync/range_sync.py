@@ -14,8 +14,10 @@ import asyncio
 import enum
 from dataclasses import dataclass, field
 
+from lodestar_tpu import tracing
 from lodestar_tpu.logger import get_logger
 from lodestar_tpu.params import active_preset
+from lodestar_tpu.scheduler import PriorityClass
 
 __all__ = ["RangeSync", "Batch", "BatchStatus", "SyncResult"]
 
@@ -116,19 +118,35 @@ class RangeSync:
             if batch.status is BatchStatus.FAILED:
                 return SyncResult(False, processed, failed_batch=batch)
 
-            # serial processing: one segment at a time (range/chain.ts:104)
+            # serial processing: one segment at a time (range/chain.ts:104).
+            # One root span per batch with each block's process_block as a
+            # child — head-of-line blocking between sync batches and gossip
+            # blocks sharing the verifier pool reads straight off the trace
             batch.status = BatchStatus.PROCESSING
             try:
-                for signed in batch.blocks:
-                    from lodestar_tpu.chain.chain import BlockError, BlockErrorCode
+                with tracing.root("range_sync_batch", slot=batch.start_slot, bulk=True) as bsp:
+                    if bsp:
+                        bsp.set(
+                            start_slot=batch.start_slot,
+                            blocks=len(batch.blocks),
+                            attempt=batch.processing_attempts + 1,
+                            peer=batch.peer or "",
+                        )
+                    for signed in batch.blocks:
+                        from lodestar_tpu.chain.chain import BlockError, BlockErrorCode
 
-                    try:
-                        await self.chain.process_block(signed)
-                        processed += 1
-                    except BlockError as e:
-                        if e.code == BlockErrorCode.ALREADY_KNOWN:
-                            continue
-                        raise
+                        try:
+                            await self.chain.process_block(
+                                signed, priority=PriorityClass.RANGE_SYNC
+                            )
+                            processed += 1
+                        except BlockError as e:
+                            if e.code == BlockErrorCode.ALREADY_KNOWN:
+                                continue
+                            raise
+                    # a duplicate's nested pipeline may have requested a
+                    # discard; the batch trace is ours and stays
+                    tracing.keep()
                 batch.status = BatchStatus.PROCESSED
                 next_to_process += 1
             except Exception as e:

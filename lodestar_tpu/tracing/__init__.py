@@ -62,6 +62,7 @@ __all__ = [
     "root",
     "current",
     "discard",
+    "keep",
     "traced",
     "record",
     "context_header",
@@ -189,6 +190,11 @@ class Trace:
         self.spans: list[Span] = []  # completion order
         self.root: Span | None = None
         self.discarded = False  # dropped on completion (no pipeline ran)
+        # bulk traces (a range-sync batch over many blocks) are exempt
+        # from the per-slot slow policy + pipeline histogram: a routine
+        # 30-block batch is not a slow SLOT and must not spam warn logs,
+        # export files, or the block-pipeline latency distribution
+        self.bulk = False
         self.start_ns = time.monotonic_ns()
         self.end_ns: int | None = None
         self._lock = threading.Lock()
@@ -263,14 +269,24 @@ class Tracer:
         enabled: bool = False,
         slow_slot_ms: float = 2000.0,
         export_dir: str | None = None,
+        export_max_files: int | None = 256,
+        export_max_age_s: float | None = None,
         ring_size: int = 64,
         metrics=None,
+        lag_ms_supplier=None,
     ):
         self.enabled = enabled
         self.slow_slot_ms = slow_slot_ms
         self.export_dir = export_dir
+        # retention for --tracing-export-dir: a long-running node's slow
+        # slots must not grow the directory unbounded
+        self.export_max_files = export_max_files
+        self.export_max_age_s = export_max_age_s
         self.ring: deque[Trace] = deque(maxlen=ring_size)
         self.metrics = metrics  # metrics.TraceMetrics or None
+        # () -> float|None: last event-loop lag sample in ms, surfaced in
+        # slow-slot dumps (EventLoopLagSampler wires itself in here)
+        self.lag_ms_supplier = lag_ms_supplier
         self.slow_slot_dumps = 0
         self.last_slow_dump: dict | None = None
         self._lock = threading.Lock()
@@ -278,17 +294,19 @@ class Tracer:
 
     # -- span creation --------------------------------------------------------
 
-    def root(self, name: str, slot: int | None = None):
+    def root(self, name: str, slot: int | None = None, bulk: bool = False):
         """Start a trace (becomes a plain child span if one is already
         active, so nested pipelines stitch instead of fragmenting).
         Exiting a fresh root completes the trace (ring + slow-slot
-        policy + metrics)."""
+        policy + metrics). `bulk` marks many-block aggregate traces that
+        skip the per-slot slow policy and pipeline histogram."""
         if not self.enabled:
             return NOOP_SPAN
         parent = _current_span.get()
         if parent is not None:
             return self._child(parent, name)
         trace = Trace(f"{next(_trace_ids):08x}", name, slot)
+        trace.bulk = bulk
         span = Span(trace, name, trace._new_span_id(), None)
         trace.root = span
         return _RootCtx(self, span)
@@ -342,14 +360,15 @@ class Tracer:
         if m is not None:
             try:
                 m.traces_completed.inc()
-                m.block_pipeline_time.observe(trace.duration_ms / 1000.0)
+                if not trace.bulk:
+                    m.block_pipeline_time.observe(trace.duration_ms / 1000.0)
                 for s in trace.spans:
                     m.span_duration.labels(span=s.name).observe(
                         max(0.0, s.duration_ms / 1000.0)
                     )
             except Exception:
                 pass  # metric bridge must never break the pipeline
-        if trace.duration_ms > self.slow_slot_ms:
+        if trace.duration_ms > self.slow_slot_ms and not trace.bulk:
             self._dump_slow(trace)
 
     def _dump_slow(self, trace: Trace) -> None:
@@ -365,6 +384,14 @@ class Tracer:
             "critical_path": path_str,
             "spans": len(trace.spans),
         }
+        if self.lag_ms_supplier is not None:
+            # loop starvation vs device slowness: the lag sample says which
+            try:
+                lag_ms = self.lag_ms_supplier()
+                if lag_ms is not None:
+                    info["event_loop_lag_ms"] = round(lag_ms, 3)
+            except Exception:
+                pass  # the dump must never fail on an optional probe
         with self._lock:
             self.slow_slot_dumps += 1
             self.last_slow_dump = info
@@ -380,7 +407,7 @@ class Tracer:
         self._log.warn(f"slow slot {trace.slot}", info)
         if self.export_dir:
             try:
-                from .export import write_chrome_trace
+                from .export import prune_export_dir, write_chrome_trace
 
                 import os
 
@@ -389,6 +416,11 @@ class Tracer:
                     self.export_dir, f"slot{trace.slot}_{trace.trace_id}.json"
                 )
                 write_chrome_trace(out, [trace])
+                prune_export_dir(
+                    self.export_dir,
+                    max_files=self.export_max_files,
+                    max_age_s=self.export_max_age_s,
+                )
             except Exception:
                 pass  # export failures must never fail the import pipeline
 
@@ -419,8 +451,11 @@ def configure(
     enabled: bool | None = None,
     slow_slot_ms: float | None = None,
     export_dir: str | None = None,
+    export_max_files: int | None = None,
+    export_max_age_s: float | None = None,
     ring_size: int | None = None,
     metrics=None,
+    lag_ms_supplier=None,
 ) -> Tracer:
     """Mutate the global tracer in place (callers hold no stale refs)."""
     t = _TRACER
@@ -430,11 +465,17 @@ def configure(
         t.slow_slot_ms = slow_slot_ms
     if export_dir is not None:
         t.export_dir = export_dir
+    if export_max_files is not None:
+        t.export_max_files = export_max_files
+    if export_max_age_s is not None:
+        t.export_max_age_s = export_max_age_s
     if ring_size is not None:
         with t._lock:
             t.ring = deque(t.ring, maxlen=ring_size)
     if metrics is not None:
         t.metrics = metrics
+    if lag_ms_supplier is not None:
+        t.lag_ms_supplier = lag_ms_supplier
     return t
 
 
@@ -451,10 +492,10 @@ def span(name: str, parent: Span | None = None):
     return _TRACER.span(name, parent)
 
 
-def root(name: str, slot: int | None = None):
+def root(name: str, slot: int | None = None, bulk: bool = False):
     if not _TRACER.enabled:
         return NOOP_SPAN
-    return _TRACER.root(name, slot)
+    return _TRACER.root(name, slot, bulk=bulk)
 
 
 class _RootCtx:
@@ -519,6 +560,19 @@ def discard() -> None:
     sp = _current_span.get()
     if sp is not None:
         sp.trace.discarded = True
+
+
+def keep() -> None:
+    """Clear a pending discard on the active trace. An outer root that
+    aggregates nested pipelines (a range-sync batch over process_block
+    calls) owns its own completion: one ALREADY_KNOWN duplicate mid-batch
+    discards per the nested pipeline's policy, and the batch root calls
+    keep() at the end so the batch trace still lands in the ring."""
+    if not _TRACER.enabled:
+        return
+    sp = _current_span.get()
+    if sp is not None:
+        sp.trace.discarded = False
 
 
 def record(

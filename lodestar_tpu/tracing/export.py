@@ -11,11 +11,13 @@ under the slot they belong to.
 from __future__ import annotations
 
 import json
+import os
+import time
 from typing import Iterable
 
 from . import Span, Trace
 
-__all__ = ["to_chrome_trace", "write_chrome_trace"]
+__all__ = ["to_chrome_trace", "write_chrome_trace", "prune_export_dir"]
 
 
 def _event(trace: Trace, span: Span, pid: int) -> dict:
@@ -70,3 +72,63 @@ def write_chrome_trace(path: str, traces: Iterable[Trace]) -> str:
         json.dump(to_chrome_trace(traces), f, indent=1)
         f.write("\n")
     return path
+
+
+def prune_export_dir(
+    path: str,
+    *,
+    max_files: int | None = None,
+    max_age_s: float | None = None,
+    now: float | None = None,
+) -> list[str]:
+    """Retention for a --tracing-export-dir: delete trace JSONs older
+    than `max_age_s`, then the oldest (by mtime) beyond `max_files`, so a
+    long-running node's slow slots can't grow the directory unbounded.
+    Only touches the tracer's own `slot<N>_<trace_id>.json` output —
+    unrelated JSON an operator keeps in the same directory is never
+    pruned. Returns the removed paths; unlink races with an external
+    cleaner are ignored. `max_files`/`max_age_s` of None or <= 0 mean
+    unlimited (the usual CLI convention for 0)."""
+    import fnmatch
+
+    if max_files is not None and max_files <= 0:
+        max_files = None
+    if max_age_s is not None and max_age_s <= 0:
+        max_age_s = None
+
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    entries: list[tuple[float, str]] = []
+    for name in names:
+        if not fnmatch.fnmatch(name, "slot*_*.json"):
+            continue
+        full = os.path.join(path, name)
+        try:
+            entries.append((os.path.getmtime(full), full))
+        except OSError:
+            continue
+    entries.sort()  # oldest first
+    now = time.time() if now is None else now
+    removed: list[str] = []
+
+    def _unlink(full: str) -> None:
+        try:
+            os.unlink(full)
+            removed.append(full)
+        except OSError:
+            pass
+
+    if max_age_s is not None:
+        fresh = []
+        for mtime, full in entries:
+            if now - mtime > max_age_s:
+                _unlink(full)
+            else:
+                fresh.append((mtime, full))
+        entries = fresh
+    if max_files is not None and len(entries) > max_files:
+        for _mtime, full in entries[: len(entries) - max_files]:
+            _unlink(full)
+    return removed

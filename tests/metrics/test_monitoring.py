@@ -7,7 +7,11 @@ from __future__ import annotations
 import asyncio
 import time
 
-from lodestar_tpu.metrics.monitoring import VERSION, MonitoringService
+from lodestar_tpu.metrics.monitoring import (
+    VERSION,
+    EventLoopLagSampler,
+    MonitoringService,
+)
 
 
 class _Head:
@@ -92,3 +96,48 @@ def test_failed_push_never_raises_and_loop_continues():
 
     asyncio.run(go())  # would raise out of go() if the loop leaked the error
     assert len(calls) >= 3
+
+
+def test_event_loop_lag_sampler_observes_histogram():
+    """ROADMAP: the lodestar_event_loop_lag_seconds histogram finally has
+    an observer — the sampler's sleep overshoot — and keeps the last
+    sample for slow-slot dumps."""
+    from lodestar_tpu.metrics import create_metrics
+
+    m = create_metrics()
+    sampler = EventLoopLagSampler(m.process.event_loop_lag, interval_s=0.01)
+    assert sampler.last_lag_ms() is None
+
+    async def go():
+        sampler.start()
+        # a deliberate loop stall the sampler must attribute as lag
+        await asyncio.sleep(0.02)
+        time.sleep(0.05)
+        await asyncio.sleep(0.03)
+        await sampler.stop()
+
+    asyncio.run(go())
+    count = m.creator.registry.get_sample_value("lodestar_event_loop_lag_seconds_count")
+    assert count and count >= 1
+    assert sampler.last_lag_s is not None and sampler.last_lag_ms() >= 0.0
+    # the blocking sleep showed up in at least one sample
+    total = m.creator.registry.get_sample_value("lodestar_event_loop_lag_seconds_sum")
+    assert total >= 0.03
+
+
+def test_lag_sampler_surfaces_in_slow_slot_dumps():
+    from lodestar_tpu import tracing
+
+    tracing.reset()
+    try:
+        sampler = EventLoopLagSampler(None, interval_s=0.01)
+        sampler.last_lag_s = 0.123  # as if the loop had just stalled
+        tracing.configure(
+            enabled=True, slow_slot_ms=0.0, lag_ms_supplier=sampler.last_lag_ms
+        )
+        with tracing.root("block_import", slot=3):
+            time.sleep(0.001)
+        dump = tracing.get_tracer().last_slow_dump
+        assert dump is not None and dump["event_loop_lag_ms"] == 123.0
+    finally:
+        tracing.reset()

@@ -13,11 +13,15 @@ from lodestar_tpu.crypto.bls import api as bls
 from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_sets
 from lodestar_tpu.offload import (
     OffloadError,
+    STATUS_FRAME_BYTES,
     decode_sets,
+    decode_status,
     decode_verdict,
     encode_sets,
+    encode_status,
     encode_verdict,
 )
+from lodestar_tpu.scheduler import AdmissionState
 from lodestar_tpu.offload.client import BlsOffloadClient
 from lodestar_tpu.offload.server import BlsOffloadServer
 from lodestar_tpu.state_transition.genesis import interop_secret_keys
@@ -58,6 +62,68 @@ def test_frame_roundtrip_and_malformed():
     assert decode_verdict(encode_verdict(False)) is False
     with pytest.raises(OffloadError, match="boom"):
         decode_verdict(encode_verdict(None, error="boom"))
+
+
+def test_status_frame_roundtrip():
+    frame = encode_status(
+        occupancy_permille=734, queue_depth=17, admission=AdmissionState.SHED_BULK
+    )
+    assert len(frame) == STATUS_FRAME_BYTES
+    st = decode_status(frame)
+    assert st.extended and st.can_accept
+    assert st.admission is AdmissionState.SHED_BULK
+    assert st.occupancy_permille == 734 and st.queue_depth == 17
+
+    # REJECT zeroes the legacy byte so old clients shed load too
+    rej = encode_status(occupancy_permille=990, queue_depth=999, admission=2)
+    assert rej[0] == 0
+    st = decode_status(rej)
+    assert not st.can_accept and st.admission is AdmissionState.REJECT
+
+    # values clamp instead of overflowing the fixed-width fields
+    clamped = decode_status(
+        encode_status(occupancy_permille=5000, queue_depth=2**40, admission=0)
+    )
+    assert clamped.occupancy_permille == 1000 and clamped.queue_depth == 0xFFFFFFFF
+
+
+def test_status_frame_backward_compat_with_single_byte_reply():
+    # NEW client, OLD server: the bare can-accept byte still parses, with
+    # occupancy unknown and admission synthesized from the binary gate
+    ok = decode_status(b"\x01")
+    assert ok.can_accept and not ok.extended
+    assert ok.admission is AdmissionState.ACCEPT
+    assert ok.occupancy_permille is None and ok.queue_depth is None
+    no = decode_status(b"\x00")
+    assert not no.can_accept and no.admission is AdmissionState.REJECT
+    with pytest.raises(OffloadError):
+        decode_status(b"")
+    # OLD client, NEW server: byte 0 of the frame IS the old reply
+    for admission, expected in ((0, 1), (1, 1), (2, 0)):
+        frame = encode_status(occupancy_permille=1, queue_depth=1, admission=admission)
+        assert frame[0] == expected
+
+
+def test_server_status_reports_occupancy_and_admission(minimal_preset):
+    server = BlsOffloadServer(verify_signature_sets, port=0)
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}")
+    try:
+
+        async def go():
+            assert await client.verify_signature_sets(_sets(2))
+
+        asyncio.run(go())
+        st = decode_status(server._status(b"", None))
+        assert st.extended and st.can_accept
+        assert st.admission is AdmissionState.ACCEPT
+        assert 0 <= st.occupancy_permille <= 1000
+        assert st.queue_depth == 0  # nothing in flight after the verify
+        # the launch actually fed the tracker
+        assert server.occupancy.busy_ns_total > 0
+    finally:
+        asyncio.run(client.close())
+        server.stop()
 
 
 def test_grpc_roundtrip_real_bls():

@@ -1,0 +1,13 @@
+"""Scheduler tests that enable tracing mutate the process-global tracer;
+isolate every test (same policy as tests/tracing)."""
+
+import pytest
+
+from lodestar_tpu import tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracing.reset()
+    yield
+    tracing.reset()

@@ -1,0 +1,70 @@
+"""--tracing-export-dir retention (ROADMAP): max-file cap plus
+age-based pruning so long-running nodes don't grow the dir unbounded."""
+
+from __future__ import annotations
+
+import os
+import time
+
+from lodestar_tpu import tracing
+from lodestar_tpu.tracing.export import prune_export_dir
+
+
+def _mk(tmp_path, name: str, age_s: float = 0.0) -> str:
+    p = tmp_path / name
+    p.write_text("{}")
+    if age_s:
+        old = time.time() - age_s
+        os.utime(p, (old, old))
+    return str(p)
+
+
+def test_prune_by_count_keeps_newest(tmp_path):
+    for i in range(10):
+        _mk(tmp_path, f"slot{i}_t.json", age_s=100 - i)  # slot9 newest
+    removed = prune_export_dir(str(tmp_path), max_files=4)
+    assert len(removed) == 6
+    left = sorted(p.name for p in tmp_path.glob("*.json"))
+    assert left == ["slot6_t.json", "slot7_t.json", "slot8_t.json", "slot9_t.json"]
+
+
+def test_prune_by_age_and_foreign_files_untouched(tmp_path):
+    _mk(tmp_path, "slot1_aa.json", age_s=3600)
+    _mk(tmp_path, "slot2_bb.json")
+    _mk(tmp_path, "keep.log", age_s=7200)  # not ours: never pruned
+    _mk(tmp_path, "dashboard.json", age_s=7200)  # foreign json: never pruned
+    removed = prune_export_dir(str(tmp_path), max_age_s=600)
+    assert [os.path.basename(r) for r in removed] == ["slot1_aa.json"]
+    assert sorted(p.name for p in tmp_path.iterdir()) == [
+        "dashboard.json",
+        "keep.log",
+        "slot2_bb.json",
+    ]
+
+
+def test_prune_handles_missing_dir_and_no_limits(tmp_path):
+    assert prune_export_dir(str(tmp_path / "nope")) == []
+    _mk(tmp_path, "slot1_a.json")
+    assert prune_export_dir(str(tmp_path)) == []  # no limits -> no-op
+    # 0 means unlimited (CLI convention), not "delete everything"
+    assert prune_export_dir(str(tmp_path), max_files=0, max_age_s=0) == []
+    assert (tmp_path / "slot1_a.json").exists()
+
+
+def test_slow_slot_dumps_respect_the_file_cap(tmp_path):
+    tracing.configure(
+        enabled=True,
+        slow_slot_ms=0.0,
+        export_dir=str(tmp_path),
+        export_max_files=2,
+    )
+    for slot in range(5):
+        with tracing.root("block_import", slot=slot):
+            time.sleep(0.001)
+    tracer = tracing.get_tracer()
+    assert tracer.slow_slot_dumps == 5
+    files = sorted(p.name for p in tmp_path.glob("*.json"))
+    assert len(files) == 2
+    # the survivors are the newest dumps
+    assert any(f.startswith("slot4_") for f in files)
+    assert any(f.startswith("slot3_") for f in files)

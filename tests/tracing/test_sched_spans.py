@@ -1,0 +1,125 @@
+"""Sched-aware spans on the block-production path and range-sync batch
+span propagation (ROADMAP items riding the scheduler PR)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu import params, ssz, tracing
+from lodestar_tpu.chain.bls import BlsVerifierMock
+from lodestar_tpu.chain.chain import BeaconChain
+from lodestar_tpu.chain.produce_block import produce_block
+from lodestar_tpu.crypto.bls.api import sign
+from lodestar_tpu.db import MemoryDbController
+from lodestar_tpu.params import DOMAIN_RANDAO
+from lodestar_tpu.state_transition import compute_signing_root, get_domain, process_slots
+from lodestar_tpu.state_transition.genesis import create_interop_genesis_state, interop_secret_keys
+
+from ..chain.test_chain import _chain_of_blocks
+
+N = 16
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+def test_block_production_trace_covers_packing_advance_and_htr(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=1,
+    )
+    work = genesis.copy()
+    ctx = process_slots(work, 1, p)
+    proposer = ctx.get_beacon_proposer(1)
+    reveal = sign(
+        sks[proposer], compute_signing_root(ssz.uint64, 0, get_domain(work, DOMAIN_RANDAO))
+    )
+    tracer = tracing.configure(enabled=True, slow_slot_ms=60_000.0)
+
+    block = produce_block(chain, slot=1, randao_reveal=reveal)
+    assert block.proposer_index == proposer
+
+    (trace,) = tracer.traces_for_slot(1)
+    assert trace.root.name == "block_production"
+    names = {s.name for s in trace.spans}
+    assert {
+        "produce_state_advance",
+        "produce_op_pool_packing",
+        "produce_stf",
+        "produce_hash_tree_root",
+    } <= names
+    # sched-aware: BlsVerifierMock has no occupancy tracker, so the root
+    # simply carries no occupancy attr — a device pool adds it
+    assert "sched_occupancy_permille" not in (trace.root.attrs or {})
+
+    # with a scheduler-backed verifier the root is occupancy-stamped
+    from lodestar_tpu.chain.bls import BlsDeviceVerifierPool
+
+    chain.bls = BlsDeviceVerifierPool(lambda sets: True)
+    block2 = produce_block(chain, slot=2, randao_reveal=reveal)
+    assert block2.slot == 2
+    (trace2,) = tracer.traces_for_slot(2)
+    assert trace2.root.attrs["sched_occupancy_permille"] == 0
+
+    # disabled tracing leaves production span-free
+    tracing.reset()
+    block3 = produce_block(chain, slot=3, randao_reveal=reveal)
+    assert block3.slot == 3
+    assert len(tracing.get_tracer().ring) == 0
+
+
+def test_range_sync_batch_root_with_per_block_children(minimal_preset):
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+    blocks = _chain_of_blocks(genesis, sks, p, 2 * p.SLOTS_PER_EPOCH)
+
+    class Net:
+        async def blocks_by_range(self, peer, start, count):
+            return [b for b in blocks if start <= b.message.slot < start + count]
+
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=BlsVerifierMock(True),
+        db=MemoryDbController(),
+        current_slot=2 * p.SLOTS_PER_EPOCH,
+    )
+    # one block pre-imported: the batch hits ALREADY_KNOWN mid-stream and
+    # its trace must survive the nested pipeline's discard request
+    asyncio.run(chain.process_block(blocks[0]))
+
+    from lodestar_tpu.sync.range_sync import RangeSync
+
+    # slow_slot_ms=0: every trace exceeds the threshold, but batch traces
+    # are bulk-exempt — a routine multi-block batch is not a slow SLOT
+    # and must not spam warn logs / export files
+    tracer = tracing.configure(enabled=True, slow_slot_ms=0.0)
+    rs = RangeSync(chain=chain, network=Net(), peers=["p1"])
+    result = asyncio.run(rs.sync(1, 2 * p.SLOTS_PER_EPOCH))
+    assert result.completed
+    assert tracer.slow_slot_dumps == 0
+
+    batch_traces = [t for t in tracer.ring if t.root and t.root.name == "range_sync_batch"]
+    assert len(batch_traces) == 2  # one per epoch batch
+    first = batch_traces[0]
+    assert first.root.attrs["blocks"] == p.SLOTS_PER_EPOCH
+    assert first.root.attrs["start_slot"] == 1
+    # per-block children: each import nests as a process_block span under
+    # the batch root, so head-of-line blocking reads off one trace
+    kids = [s for s in first.spans if s.name == "process_block"]
+    assert len(kids) == p.SLOTS_PER_EPOCH
+    assert all(k.parent_id == first.root.span_id for k in kids)
+    # the imports really ran the pipeline inside the batch trace
+    assert {s.name for s in first.spans} >= {"state_transition", "fork_choice"}

@@ -278,6 +278,7 @@ def all_dashboards():
         ("lodestar_reqresp_api.json", reqresp_api_dashboard()),
         ("lodestar_db.json", db_dashboard()),
         ("lodestar_block_pipeline_trace.json", trace_dashboard()),
+        ("lodestar_sched_occupancy.json", sched_dashboard()),
     )
 
 
@@ -486,6 +487,65 @@ def trace_dashboard():
         "Lodestar TPU - Block pipeline trace",
         ps,
         ["lodestar", "tracing"],
+    )
+
+
+def sched_dashboard():
+    """Device work scheduler (lodestar_tpu/scheduler): EWMA occupancy +
+    graded admission, per-launch-class queue depth/wait/serve rates, and
+    the anti-starvation/shed counters. The "can this host absorb another
+    beacon node" dashboard."""
+    ps = [
+        panel(
+            "Device occupancy (busy-ns per wall-ns, ‰)",
+            [("lodestar_sched_occupancy_permille", "occupancy ‰")],
+            pid=1,
+        ),
+        panel(
+            "Admission state",
+            [("lodestar_sched_admission_state", "0 accept / 1 shed-bulk / 2 reject")],
+            x=12, pid=2,
+        ),
+        panel(
+            "Launch queue depth by class",
+            [("lodestar_sched_queue_depth", "{{class}}")],
+            y=8, pid=3,
+        ),
+        panel(
+            "Queue wait p95 by class",
+            [
+                (
+                    "histogram_quantile(0.95, sum by (class, le) "
+                    "(rate(lodestar_sched_queue_wait_seconds_bucket[5m])))",
+                    "{{class}}",
+                ),
+            ],
+            unit="s", x=12, y=8, pid=4,
+        ),
+        panel(
+            "Dequeue rate by class",
+            [
+                (
+                    "sum by (class) (rate(lodestar_sched_jobs_dequeued_total[5m]))",
+                    "{{class}}",
+                ),
+            ],
+            unit="ops", y=16, pid=5,
+        ),
+        panel(
+            "Starvation promotions / shed work",
+            [
+                ("rate(lodestar_sched_starvation_promotions_total[5m])", "aging promotions"),
+                ("sum by (class) (rate(lodestar_sched_shed_total[5m]))", "shed {{class}}"),
+            ],
+            unit="ops", x=12, y=16, pid=6,
+        ),
+    ]
+    return dashboard(
+        "lodestar-sched-occupancy",
+        "Lodestar TPU - Device work scheduler",
+        ps,
+        ["lodestar", "scheduler"],
     )
 
 
