@@ -1,5 +1,6 @@
 """BLS verification seam + device pool (reference `chain/bls/`)."""
 
+from .fallback import DegradingBlsVerifier  # noqa: F401
 from .interface import (  # noqa: F401
     BlsSingleThreadVerifier,
     BlsVerifierMock,

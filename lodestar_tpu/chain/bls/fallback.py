@@ -1,0 +1,125 @@
+"""Verified degradation chain: offload → local device pool → CPU oracle.
+
+The offload leg fails CLOSED — correct, but availability-hostile: with
+the accelerator host down, every block import rejects until it returns.
+`DegradingBlsVerifier` restores availability WITHOUT weakening the
+fail-closed invariant: each layer in the chain is a full `IBlsVerifier`
+that actually RE-VERIFIES the signature sets (`crypto/bls/api.py` is
+the documented oracle + fallback); a layer's *error* hands the same
+sets to the next layer, a layer's *False* is final (an invalid-set
+verdict is an answer, not a failure — falling through on False would
+let a strict layer be shopped around for a lenient one).
+
+So across every layer: no path resolves True except a layer genuinely
+verifying the sets, and the chain only raises when every layer erred —
+exactly the old single-verifier fail-closed semantics, now reached far
+less often. Layers that report `is_down()` (offload with every breaker
+open, a wedged device pool) are skipped without an attempt, so
+degradation costs no RPC timeout. Down is deliberately distinct from
+busy: a saturated-but-alive layer is still attempted and still governs
+`can_accept_work()`, so gossip backpressure keeps shedding instead of
+silently funneling every verify onto a slower fallback layer.
+
+Every downgrade records a `bls_fallback` trace span and a
+`lodestar_resilience_fallback_*` metric; `last_layer` names the layer
+that served the most recent verdict (surfaced into the block import
+trace)."""
+
+from __future__ import annotations
+
+from lodestar_tpu import tracing
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.logger import get_logger
+
+from .interface import IBlsVerifier, VerifySignatureOpts
+
+__all__ = ["DegradingBlsVerifier"]
+
+
+class DegradingBlsVerifier(IBlsVerifier):
+    def __init__(self, layers: list[tuple[str, IBlsVerifier]], *, metrics=None) -> None:
+        """`layers`: ordered (name, verifier) pairs, preferred first.
+        The degrader owns them — `close()` closes every layer."""
+        if not layers:
+            raise ValueError("at least one verifier layer required")
+        self.layers = list(layers)
+        self.last_layer: str | None = None
+        self._metrics = metrics
+        self._log = get_logger(name="lodestar.bls-degrade")
+
+    async def verify_signature_sets(
+        self, sets: list[SignatureSet], opts: VerifySignatureOpts | None = None
+    ) -> bool:
+        last_err: Exception | None = None
+        primary = self.layers[0][0]
+        for name, layer in self.layers:
+            if _layer_down(layer):
+                self._note_skip(name)
+                continue
+            if name != primary or last_err is not None:
+                self._note_fallback(name, last_err)
+            try:
+                verdict = await layer.verify_signature_sets(sets, opts)
+            except Exception as e:  # this layer erred: degrade, re-verify
+                last_err = e
+                self._log.warn(
+                    "bls verifier layer failed, degrading",
+                    {"layer": name, "error": str(e)[:120]},
+                )
+                continue
+            self.last_layer = name
+            if self._metrics is not None:
+                self._metrics.fallback_active.set(0 if name == primary else 1)
+                if name != primary:
+                    # counted on SERVE, not attempt: a fallback layer that
+                    # also errs must not show up as having served verdicts
+                    self._metrics.fallback_verifications.labels(name).inc()
+            return verdict
+        # every layer erred or refused: fail closed with the last error
+        if last_err is not None:
+            raise last_err
+        raise RuntimeError("no bls verifier layer accepts work")
+
+    def _note_skip(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.fallback_skipped.labels(name).inc()
+
+    def _note_fallback(self, name: str, err: Exception | None) -> None:
+        parent = tracing.current()
+        if parent is not None:
+            import time
+
+            now = time.monotonic_ns()
+            attrs = {"layer": name}
+            if err is not None:
+                attrs["after_error"] = str(err)[:120]
+            tracing.record(parent, "bls_fallback", now, now, attrs)
+
+    def can_accept_work(self) -> bool:
+        """The first layer still in rotation governs admission: a DOWN
+        primary hands the decision to its fallback, but a merely
+        SATURATED primary's refusal stands — the gossip processor must
+        shed (the pre-degradation backpressure contract) rather than
+        drain every queue into the slowest layer."""
+        for _, layer in self.layers:
+            if _layer_down(layer):
+                continue
+            return layer.can_accept_work()
+        return False
+
+    async def close(self) -> None:
+        for _, layer in self.layers:
+            try:
+                await layer.close()
+            except Exception:
+                pass
+
+
+def _layer_down(layer: IBlsVerifier) -> bool:
+    """A layer is out of rotation only when it SAYS it's down (offload
+    client / device pool expose `is_down`); verifiers without the
+    concept are always attempted — their errors degrade anyway, and
+    inferring down from can_accept_work would reintroduce the
+    silent-degradation-on-saturation this module exists to prevent."""
+    is_down = getattr(layer, "is_down", None)
+    return bool(is_down()) if callable(is_down) else False

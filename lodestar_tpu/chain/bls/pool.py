@@ -18,6 +18,11 @@ N-worker thread pool replaced by one device pipeline:
 * **Fail-closed** (`index.ts:386-393` analogue): any backend error rejects
   the job with the error — it never resolves True. Callers treat rejection
   as invalid-block/peer-downscore, exactly like the reference.
+* **Wedge detection** (`offload/resilience.CircuitBreaker`): consecutive
+  backend errors open a device breaker and `can_accept_work()` goes
+  False — a wedged device (driver hang, OOM loop) stops attracting work
+  and a `DegradingBlsVerifier` skips the pool without paying one failed
+  launch per call; after the reset delay the pool self-offers again.
 * **Admission** (`index.ts:143-149`): can_accept_work() false once
   MAX_JOBS_CAN_ACCEPT_WORK (512) jobs are outstanding — backpressure
   signal for the gossip processor.
@@ -73,6 +78,10 @@ MAX_BUFFERED_SIGS = 32
 MAX_BUFFER_WAIT_MS = 100
 MAX_JOBS_CAN_ACCEPT_WORK = 512
 BATCHABLE_MIN_PER_CHUNK = 16  # worker.ts:11-17
+# consecutive backend errors before the pool reports itself wedged
+# (can_accept_work False) — high enough that one bad batch + its retries
+# can't trip it, low enough to stop a launch storm against a hung driver
+DEVICE_WEDGE_THRESHOLD = 8
 # sets per launch package under the scheduler: a queued attestation
 # flood must not coalesce into one giant package that head-of-line
 # blocks an arriving gossip block for its whole duration
@@ -135,6 +144,15 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         self._buffer_wait_ms = buffer_wait_ms
         self._max_buffered_sigs = max_buffered_sigs
         self._log = get_logger(name="lodestar.bls-pool")
+        # wedge detection: consecutive launch errors open it, a success
+        # (or the reset delay elapsing) re-offers the pool for work
+        from lodestar_tpu.offload.resilience import CircuitBreaker
+
+        self.device_breaker = CircuitBreaker(
+            failure_threshold=DEVICE_WEDGE_THRESHOLD,
+            reset_timeout_s=5.0,
+            max_reset_timeout_s=60.0,
+        )
 
         self.scheduler_enabled = scheduler_enabled
         self._sched_metrics = sched_metrics
@@ -176,8 +194,14 @@ class BlsDeviceVerifierPool(IBlsVerifier):
 
     # -- IBlsVerifier ---------------------------------------------------------
 
+    def is_down(self) -> bool:
+        """Wedged device (breaker open) or closed — the degradation
+        chain routes around the pool; mere queue saturation is NOT down
+        (that's backpressure, handled by can_accept_work)."""
+        return self._closed or self.device_breaker.is_open
+
     def can_accept_work(self) -> bool:
-        return not self._closed and self._outstanding < MAX_JOBS_CAN_ACCEPT_WORK
+        return not self.is_down() and self._outstanding < MAX_JOBS_CAN_ACCEPT_WORK
 
     async def verify_signature_sets(
         self, sets: list[SignatureSet], opts: VerifySignatureOpts | None = None
@@ -339,7 +363,9 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             try:
                 with trace_region("bls_batch_verify"), self.occupancy.launch():
                     ok = self._verify_fn(all_sets)
+                self.device_breaker.record_success()
             except Exception:
+                self.device_breaker.record_failure()
                 self.metrics["batch_retries"] += 1
                 if traced:
                     self._trace_launch(chunk, t0, len(all_sets), "batch_error")
@@ -360,10 +386,12 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             try:
                 with self.occupancy.launch():
                     ok = self._verify_fn(j.sets)
+                self.device_breaker.record_success()
                 if traced:
                     self._trace_launch([j], t0, len(j.sets), "single")
                 self._resolve(j, ok)
             except Exception as e:
+                self.device_breaker.record_failure()
                 if traced:
                     self._trace_launch([j], t0, len(j.sets), "single_error")
                 if not j.future.done():

@@ -410,9 +410,16 @@ class BeaconChain:
             with tracing.span("bls_verify") as sp:
                 if sp:
                     sp.set(sets=len(sets))
-                return await self.bls.verify_signature_sets(
+                ok = await self.bls.verify_signature_sets(
                     sets, VerifySignatureOpts(batchable=False, priority=priority)
                 )
+                if sp:
+                    # DegradingBlsVerifier names the layer that actually
+                    # served — a slow-slot dump shows degraded imports
+                    layer = getattr(self.bls, "last_layer", None)
+                    if layer is not None:
+                        sp.set(verifier_layer=layer)
+                return ok
 
         sig_task = asyncio.ensure_future(run_sigs())
         stf_parent = tracing.current()  # executor threads don't see contextvars

@@ -55,6 +55,28 @@ def _add_scheduler_args(sp) -> None:
         help="disable the priority-aware device work scheduler (FIFO launches; "
         "debug/comparison only)",
     )
+    from lodestar_tpu.offload.resilience import (
+        DEFAULT_FAILURE_THRESHOLD,
+        DEFAULT_MAX_RESET_TIMEOUT_S,
+        DEFAULT_RESET_TIMEOUT_S,
+    )
+
+    sp.add_argument(
+        "--offload-breaker-threshold", type=int, default=DEFAULT_FAILURE_THRESHOLD,
+        help="consecutive verify failures before an offload endpoint's circuit "
+        "breaker opens (the hot path then skips it without dialing)",
+    )
+    sp.add_argument(
+        "--offload-breaker-reset-sec", type=float, default=DEFAULT_RESET_TIMEOUT_S,
+        help="base delay before an open breaker admits a half-open trial (doubles "
+        f"per consecutive open, capped at {DEFAULT_MAX_RESET_TIMEOUT_S:g}s, jittered)",
+    )
+    sp.add_argument(
+        "--offload-fallback", choices=["none", "cpu", "device"], default="cpu",
+        help="degradation chain when offload fails: cpu = re-verify on the CPU "
+        "oracle, device = local device pool then CPU, none = fail closed with "
+        "no fallback (blocks reject while the offload host is down)",
+    )
 
 
 def _build_parser(with_subparsers: bool = False):
@@ -254,6 +276,9 @@ async def _run_dev(args) -> int:
             tracing_export_max_files=args.tracing_export_max_files,
             tracing_export_max_age_s=args.tracing_export_max_age_sec,
             offload_endpoints=args.bls_offload,
+            offload_breaker_threshold=args.offload_breaker_threshold,
+            offload_breaker_reset_s=args.offload_breaker_reset_sec,
+            offload_fallback=args.offload_fallback,
             scheduler_enabled=not args.sched_disable,
         ),
         p=p,
@@ -407,6 +432,9 @@ async def _run_beacon(args) -> int:
             tracing_export_max_files=args.tracing_export_max_files,
             tracing_export_max_age_s=args.tracing_export_max_age_sec,
             offload_endpoints=args.bls_offload,
+            offload_breaker_threshold=args.offload_breaker_threshold,
+            offload_breaker_reset_s=args.offload_breaker_reset_sec,
+            offload_fallback=args.offload_fallback,
             scheduler_enabled=not args.sched_disable,
         ),
         p=p,

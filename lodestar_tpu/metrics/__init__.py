@@ -30,6 +30,7 @@ __all__ = [
     "BeaconMetrics",
     "TraceMetrics",
     "SchedulerMetrics",
+    "ResilienceMetrics",
     "create_metrics",
     "MetricsServer",
     "ValidatorMonitor",
@@ -275,6 +276,25 @@ class SchedulerMetrics:
 
 
 @dataclass
+class ResilienceMetrics:
+    """lodestar_resilience_* — the offload resilience layer
+    (`offload/resilience.py`, `chain/bls/fallback.py`): per-endpoint
+    routing/failover/hedge counts, circuit-breaker states, and the
+    degradation-chain fallback counters."""
+
+    routed: Counter  # verify RPCs issued, labeled by endpoint
+    shed: Counter  # client-side admission sheds, labeled by reason
+    failovers: Counter  # failed attempts per endpoint (breaker input)
+    hedges: Counter  # hedged retries issued, labeled by launch class
+    hedge_wins: Counter  # hedged retries that returned the verdict
+    breaker_state: Gauge  # 0 closed / 1 half-open / 2 open, per endpoint
+    breaker_transitions: Counter  # labeled by endpoint and new state
+    fallback_verifications: Counter  # degraded verifications served, by layer
+    fallback_skipped: Counter  # layers skipped (not accepting), by layer
+    fallback_active: Gauge  # 1 while a non-primary layer served last
+
+
+@dataclass
 class TraceMetrics:
     """lodestar_trace_* — span-duration summaries derived from the
     per-slot pipeline tracer (`lodestar_tpu/tracing`): every completed
@@ -309,6 +329,7 @@ class BeaconMetrics:
     process: "ProcessMetrics"
     trace: "TraceMetrics"
     sched: "SchedulerMetrics"
+    resilience: "ResilienceMetrics"
     head_slot: Gauge
     finalized_epoch: Gauge
     justified_epoch: Gauge
@@ -645,6 +666,57 @@ def create_metrics() -> BeaconMetrics:
             "lodestar_trace_slow_slot_total", "Slow-slot trace dumps emitted"
         ),
     )
+    resilience = ResilienceMetrics(
+        routed=c.counter(
+            "lodestar_resilience_routed_total",
+            "Offload verify RPCs issued per endpoint",
+            ["endpoint"],
+        ),
+        shed=c.counter(
+            "lodestar_resilience_shed_total",
+            "Gossip work deferred because the offload verifier refused admission",
+            ["reason"],
+        ),
+        failovers=c.counter(
+            "lodestar_resilience_failover_total",
+            "Failed offload attempts per endpoint (feeds the breaker)",
+            ["endpoint"],
+        ),
+        hedges=c.counter(
+            "lodestar_resilience_hedge_total",
+            "Hedged retries issued to a second endpoint, by launch class",
+            ["class"],
+        ),
+        hedge_wins=c.counter(
+            "lodestar_resilience_hedge_win_total",
+            "Hedged retries that returned the verdict, by launch class",
+            ["class"],
+        ),
+        breaker_state=c.gauge(
+            "lodestar_resilience_breaker_state",
+            "Offload circuit breaker per endpoint: 0 closed / 1 half-open / 2 open",
+            ["endpoint"],
+        ),
+        breaker_transitions=c.counter(
+            "lodestar_resilience_breaker_transitions_total",
+            "Breaker state transitions per endpoint and new state",
+            ["endpoint", "state"],
+        ),
+        fallback_verifications=c.counter(
+            "lodestar_resilience_fallback_total",
+            "Verifications served after degrading to this layer",
+            ["layer"],
+        ),
+        fallback_skipped=c.counter(
+            "lodestar_resilience_fallback_skipped_total",
+            "Verifier layers skipped because they refused work",
+            ["layer"],
+        ),
+        fallback_active=c.gauge(
+            "lodestar_resilience_fallback_active",
+            "1 while the most recent verification was served by a non-primary layer",
+        ),
+    )
     sched = SchedulerMetrics(
         queue_depth=c.gauge(
             "lodestar_sched_queue_depth", "Device scheduler queue depth", ["class"]
@@ -693,6 +765,7 @@ def create_metrics() -> BeaconMetrics:
         process=process,
         trace=trace,
         sched=sched,
+        resilience=resilience,
         head_slot=c.gauge("beacon_head_slot", "Current head slot"),
         finalized_epoch=c.gauge("beacon_finalized_epoch", "Finalized epoch"),
         justified_epoch=c.gauge("beacon_current_justified_epoch", "Justified epoch"),

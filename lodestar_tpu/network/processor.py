@@ -155,6 +155,7 @@ class NetworkProcessor:
         submitted = 0
         sched_metrics = getattr(self.metrics, "sched", None)
         shed_topics: set[str] = set()
+        shed_reasons: set[str] = set()
         while submitted < max_jobs:
             reason = self._cannot_accept_reason()
             progressed = False
@@ -162,6 +163,7 @@ class NetworkProcessor:
                 if reason is not None and topic not in BYPASS_BACKPRESSURE:
                     if len(self.queues[topic]):
                         shed_topics.add(topic)
+                        shed_reasons.add(reason)
                     continue
                 handler = self.handlers.get(topic)
                 if handler is None:
@@ -194,6 +196,12 @@ class NetworkProcessor:
             # backpressure deferred, labeled by their BLS launch class
             for topic in shed_topics:
                 sched_metrics.shed_total.labels(_TOPIC_SHED_CLASS[topic]).inc()
+        resilience = getattr(self.metrics, "resilience", None)
+        if resilience is not None:
+            # per-reason shed ticks (bls_busy = offload/pool refusing
+            # admission — the client-side routing-metrics view)
+            for r in shed_reasons:
+                resilience.shed.labels(r).inc()
         return submitted
 
 

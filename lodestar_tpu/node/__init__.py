@@ -43,6 +43,9 @@ class BeaconNodeOptions:
         tracing_export_max_files: int = 256,
         tracing_export_max_age_s: float | None = None,
         offload_endpoints: list[str] | None = None,
+        offload_breaker_threshold: int | None = None,
+        offload_breaker_reset_s: float | None = None,
+        offload_fallback: str = "cpu",
         scheduler_enabled: bool = True,
     ):
         self.db_path = db_path
@@ -67,6 +70,29 @@ class BeaconNodeOptions:
         # BLS offload endpoints (host:port); non-empty routes the chain's
         # verifier through BlsOffloadClient with load-aware routing
         self.offload_endpoints = list(offload_endpoints or [])
+        # per-endpoint circuit breaker tuning; None = the resilience
+        # module's defaults (the one definition of those numbers)
+        from lodestar_tpu.offload.resilience import (
+            DEFAULT_FAILURE_THRESHOLD,
+            DEFAULT_RESET_TIMEOUT_S,
+        )
+
+        self.offload_breaker_threshold = (
+            DEFAULT_FAILURE_THRESHOLD
+            if offload_breaker_threshold is None
+            else offload_breaker_threshold
+        )
+        self.offload_breaker_reset_s = (
+            DEFAULT_RESET_TIMEOUT_S
+            if offload_breaker_reset_s is None
+            else offload_breaker_reset_s
+        )
+        # degradation chain below the offload client: "cpu" (offload →
+        # CPU oracle), "device" (offload → local device pool → CPU), or
+        # "none" (offload errors reject blocks until the host returns)
+        if offload_fallback not in ("none", "cpu", "device"):
+            raise ValueError(f"offload_fallback must be none|cpu|device, got {offload_fallback!r}")
+        self.offload_fallback = offload_fallback
         # device work scheduler (lodestar_tpu.scheduler) for the in-process
         # pool; False restores FIFO launches (debug/comparison only)
         self.scheduler_enabled = scheduler_enabled
@@ -167,12 +193,39 @@ class BeaconNode:
 
             _tracing.configure(lag_ms_supplier=lag_sampler.last_lag_ms)
 
-        # 3. bls verifier
+        # 3. bls verifier — offload endpoints get the resilience stack:
+        # breaker-guarded client, then the verified degradation chain
+        # (every layer re-verifies; errors degrade, verdicts are final)
         bls: IBlsVerifier
         if opts.offload_endpoints:
             from lodestar_tpu.offload.client import BlsOffloadClient
 
-            bls = BlsOffloadClient(opts.offload_endpoints)
+            client = BlsOffloadClient(
+                opts.offload_endpoints,
+                breaker_threshold=opts.offload_breaker_threshold,
+                breaker_reset_s=opts.offload_breaker_reset_s,
+                metrics=metrics.resilience,
+            )
+            if opts.offload_fallback == "none":
+                bls = client
+            else:
+                from lodestar_tpu.chain.bls import DegradingBlsVerifier
+
+                layers: list = [("offload", client)]
+                if opts.offload_fallback == "device":
+                    from lodestar_tpu.chain.bls import BlsDeviceVerifierPool
+
+                    layers.append(
+                        (
+                            "device_pool",
+                            BlsDeviceVerifierPool(
+                                scheduler_enabled=opts.scheduler_enabled,
+                                sched_metrics=metrics.sched,
+                            ),
+                        )
+                    )
+                layers.append(("cpu", BlsSingleThreadVerifier()))
+                bls = DegradingBlsVerifier(layers, metrics=metrics.resilience)
         elif opts.use_device_verifier:
             from lodestar_tpu.chain.bls import BlsDeviceVerifierPool
 

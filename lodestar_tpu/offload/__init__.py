@@ -16,7 +16,14 @@ owns the accelerator. This package is that boundary:
 
 Wire format (framed, no codegen needed — grpc carries opaque bytes):
   request:  u32le count || count * (pubkey48 || message32 || signature96)
-  response: u8 ok(1)/invalid(0)/error(2) || error utf-8
+  response: u8 ok(1)/invalid(0) || 0xB7 || u8 version ||
+            sha256(request || verdict_byte)[:8]
+            (digest-checked verdict: the client rejects any reply whose
+            digest doesn't bind this request to this verdict, so a
+            corrupted, truncated, or cross-spliced frame fails CLOSED
+            instead of decoding as a verdict. Legacy 1-byte verdicts
+            still parse; error replies stay u8 2 || error utf-8 — an
+            error already fails closed, corruption can't weaken it.)
   status:   u8 can_accept || 0xA5 || u8 version ||
             u8 admission(0 accept/1 shed_bulk/2 reject) ||
             u16le occupancy_permille || u32le queue_depth
@@ -27,6 +34,7 @@ Wire format (framed, no codegen needed — grpc carries opaque bytes):
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 
 from lodestar_tpu.crypto.bls.api import SignatureSet
@@ -37,12 +45,14 @@ __all__ = [
     "decode_sets",
     "encode_verdict",
     "decode_verdict",
+    "verdict_digest",
     "encode_status",
     "decode_status",
     "StatusFrame",
     "OffloadError",
     "SET_BYTES",
     "STATUS_FRAME_BYTES",
+    "VERDICT_FRAME_BYTES",
 ]
 
 SET_BYTES = 48 + 32 + 96
@@ -50,6 +60,11 @@ SET_BYTES = 48 + 32 + 96
 STATUS_MAGIC = 0xA5
 STATUS_VERSION = 1
 STATUS_FRAME_BYTES = 10
+
+VERDICT_MAGIC = 0xB7
+VERDICT_VERSION = 1
+VERDICT_DIGEST_BYTES = 8
+VERDICT_FRAME_BYTES = 3 + VERDICT_DIGEST_BYTES
 
 
 class OffloadError(Exception):
@@ -137,16 +152,58 @@ def decode_status(data: bytes) -> StatusFrame:
     )
 
 
-def encode_verdict(ok: bool | None, error: str = "") -> bytes:
+def verdict_digest(request: bytes, verdict_byte: int) -> bytes:
+    """Binds a verdict to the exact request frame it answers. Covering
+    the verdict byte means flipping invalid→ok invalidates the digest —
+    random/faulty corruption cannot mint a True verdict (a helper that
+    RECOMPUTES the digest is byzantine; that threat needs the
+    degradation chain's independent re-verification, not framing)."""
+    return hashlib.sha256(request + bytes([verdict_byte])).digest()[:VERDICT_DIGEST_BYTES]
+
+
+def encode_verdict(ok: bool | None, error: str = "", request: bytes | None = None) -> bytes:
     if error:
         return b"\x02" + error.encode()
-    return b"\x01" if ok else b"\x00"
+    v = 1 if ok else 0
+    if request is None:
+        return bytes([v])  # legacy 1-byte verdict
+    return bytes([v, VERDICT_MAGIC, VERDICT_VERSION]) + verdict_digest(request, v)
 
 
-def decode_verdict(data: bytes) -> bool:
-    """True/False, or raises OffloadError for a server-side error."""
+def decode_verdict(
+    data: bytes, request: bytes | None = None, *, require_digest: bool = False
+) -> bool:
+    """True/False, or raises OffloadError for a server-side error or a
+    frame that fails strict validation. When `request` is given and the
+    server spoke the digest-checked format, the digest must bind this
+    request to this verdict. Decoding is strict: only the exact legacy
+    1-byte frame or the exact digest frame parses — trailing garbage or
+    unknown leading bytes fail closed instead of decoding as a verdict.
+
+    `require_digest=True` rejects the legacy 1-byte frame entirely: the
+    client sets it once an endpoint has spoken the digest format, so a
+    fault (or active downgrade) that truncates replies to the bare
+    verdict byte cannot strip the integrity check afterwards."""
     if not data:
         raise OffloadError("empty verdict frame")
     if data[0] == 2:
         raise OffloadError(data[1:].decode(errors="replace") or "server error")
-    return data[0] == 1
+    if data[0] not in (0, 1):
+        raise OffloadError(f"malformed verdict frame (lead byte {data[0]})")
+    if len(data) == 1:
+        # legacy server: no digest to check (verdict-flip detection
+        # requires both ends on the digest format)
+        if require_digest:
+            raise OffloadError(
+                "bare legacy verdict from a digest-speaking server (truncation or downgrade)"
+            )
+        return data[0] == 1
+    if (
+        len(data) == VERDICT_FRAME_BYTES
+        and data[1] == VERDICT_MAGIC
+        and data[2] == VERDICT_VERSION
+    ):
+        if request is not None and bytes(data[3:]) != verdict_digest(request, data[0]):
+            raise OffloadError("verdict digest mismatch (corrupt or cross-spliced reply)")
+        return data[0] == 1
+    raise OffloadError(f"malformed verdict frame ({len(data)} bytes)")

@@ -22,6 +22,21 @@ class — bulk classes (range sync, backfill) avoid SHED_BULK endpoints,
 everything avoids REJECT, and ties break toward the least-occupied
 server. One saturated host therefore sheds its backfill traffic onto an
 idle peer while gossip keeps flowing to both.
+
+Resilience (`offload/resilience.py`): every endpoint carries a circuit
+breaker — consecutive verify failures open it and the hot path skips
+the endpoint IMMEDIATELY (no dial, no deadline wait) instead of paying
+a timeout per block until the probe loop notices; after an exponential
+reset delay one half-open trial re-closes or re-opens it. RPC deadlines
+are class-aware budgets (`CLASS_DEADLINE_S`): a gossip-block verify
+gets 2s and ONE hedged retry on a second endpoint, bulk work keeps the
+generous flat timeout. Verdict frames are digest-checked
+(`decode_verdict(request=...)`) so a corrupt or spliced reply fails
+closed instead of decoding as a verdict. All endpoint state
+transitions go through `self._lock`; the probe thread wakes via an
+event and is joined on close. With `lodestar_resilience_*` metrics
+attached, routed/failover/hedge counts and breaker states export per
+endpoint.
 """
 
 from __future__ import annotations
@@ -39,6 +54,16 @@ from lodestar_tpu.logger import get_logger
 from lodestar_tpu.scheduler import BULK_CLASSES, AdmissionState, PriorityClass
 
 from . import OffloadError, decode_status, decode_verdict, encode_sets
+from .resilience import (
+    CLASS_DEADLINE_S,
+    DEFAULT_FAILURE_THRESHOLD,
+    DEFAULT_MAX_RESET_TIMEOUT_S,
+    DEFAULT_RESET_TIMEOUT_S,
+    HEDGE_CLASSES,
+    BreakerState,
+    CircuitBreaker,
+    deadline_for,
+)
 from .server import STATUS_METHOD, VERIFY_METHOD
 
 __all__ = ["BlsOffloadClient"]
@@ -56,7 +81,11 @@ def _identity(b: bytes) -> bytes:
 
 
 class _Endpoint:
-    """One server: channel + stubs + probe-refreshed load/health state."""
+    """One server: channel + stubs + probe-refreshed load/health state.
+
+    Mutable routing state (healthy/admission/occupancy/outstanding) is
+    written ONLY under the owning client's `_lock`; the breaker has its
+    own internal lock."""
 
     __slots__ = (
         "target",
@@ -70,9 +99,11 @@ class _Endpoint:
         "queue_depth",
         "admission",
         "extended",
+        "breaker",
+        "digest_seen",
     )
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, breaker: CircuitBreaker):
         self.target = target
         self.channel = None
         self.verify = None
@@ -84,6 +115,10 @@ class _Endpoint:
         self.queue_depth: int | None = None
         self.admission = AdmissionState.ACCEPT
         self.extended = False
+        self.breaker = breaker
+        # sticky: once this server has spoken the digest-checked verdict
+        # format, a bare legacy frame is a truncation/downgrade, not compat
+        self.digest_seen = False
 
     def state(self) -> dict:
         return {
@@ -94,7 +129,15 @@ class _Endpoint:
             "queue_depth": self.queue_depth,
             "admission": self.admission.label,
             "extended": self.extended,
+            "breaker": self.breaker.state().label,
         }
+
+
+def _occupancy_key(ep: _Endpoint) -> tuple[int, int]:
+    return (
+        ep.occupancy_permille if ep.occupancy_permille is not None else _UNKNOWN_OCCUPANCY,
+        ep.outstanding,
+    )
 
 
 class BlsOffloadClient(IBlsVerifier):
@@ -105,6 +148,13 @@ class BlsOffloadClient(IBlsVerifier):
         timeout_s: float = DEFAULT_TIMEOUT_S,
         max_outstanding: int = MAX_OUTSTANDING_JOBS,
         probe_interval_s: float = HEALTH_PROBE_INTERVAL_S,
+        breaker_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+        breaker_reset_s: float = DEFAULT_RESET_TIMEOUT_S,
+        breaker_max_reset_s: float = DEFAULT_MAX_RESET_TIMEOUT_S,
+        class_deadlines: dict[PriorityClass, float] | None = None,
+        hedge_classes: frozenset[PriorityClass] | None = None,
+        metrics=None,
+        transport_wrapper=None,
     ) -> None:
         targets = [target] if isinstance(target, str) else list(target)
         if not targets:
@@ -115,10 +165,33 @@ class BlsOffloadClient(IBlsVerifier):
         self.max_outstanding = max_outstanding
         self.probe_interval_s = probe_interval_s
         self.log = get_logger(name="lodestar.offload.client")
+        # ResilienceMetrics (metrics/__init__.py) or None; duck-typed so
+        # tests can pass a stub
+        self._metrics = metrics
+        # fault-injection seam (lodestar_tpu/testing/faults.py): called as
+        # wrapper(target, method_name, callable) -> callable around every
+        # stub the client dials
+        self._transport_wrapper = transport_wrapper
+        self._class_deadlines = dict(class_deadlines or CLASS_DEADLINE_S)
+        self._hedge_classes = HEDGE_CLASSES if hedge_classes is None else hedge_classes
         self._lock = threading.Lock()
         self._outstanding = 0
         self._closed = False
-        self._endpoints = [_Endpoint(t) for t in targets]
+        self._wake = threading.Event()  # close() wakes the probe thread
+        self._endpoints = []
+        for t in targets:
+            ep = _Endpoint(
+                t,
+                CircuitBreaker(
+                    failure_threshold=breaker_threshold,
+                    reset_timeout_s=breaker_reset_s,
+                    max_reset_timeout_s=breaker_max_reset_s,
+                ),
+            )
+            # the closure must not take self._lock: breaker transitions
+            # fire while the verify thread may hold it -> metrics/log only
+            ep.breaker._on_transition = self._breaker_transition_sink(ep)
+            self._endpoints.append(ep)
         for ep in self._endpoints:
             self._connect(ep)
         self._probe_thread = threading.Thread(
@@ -130,12 +203,17 @@ class BlsOffloadClient(IBlsVerifier):
 
     def _connect(self, ep: _Endpoint) -> None:
         ep.channel = grpc.insecure_channel(ep.target)
-        ep.verify = ep.channel.unary_unary(
+        verify = ep.channel.unary_unary(
             VERIFY_METHOD, request_serializer=_identity, response_deserializer=_identity
         )
-        ep.status = ep.channel.unary_unary(
+        status = ep.channel.unary_unary(
             STATUS_METHOD, request_serializer=_identity, response_deserializer=_identity
         )
+        if self._transport_wrapper is not None:
+            verify = self._transport_wrapper(ep.target, "verify", verify)
+            status = self._transport_wrapper(ep.target, "status", status)
+        ep.verify = verify
+        ep.status = status
 
     def _reconnect(self, ep: _Endpoint) -> None:
         try:
@@ -143,6 +221,20 @@ class BlsOffloadClient(IBlsVerifier):
         except Exception:
             pass
         self._connect(ep)
+
+    def _breaker_transition_sink(self, ep: _Endpoint):
+        def sink(old: BreakerState, new: BreakerState) -> None:
+            level = self.log.warn if new is BreakerState.OPEN else self.log.info
+            level(
+                "offload breaker transition",
+                {"target": ep.target, "from": old.label, "to": new.label},
+            )
+            m = self._metrics
+            if m is not None:
+                m.breaker_state.labels(ep.target).set(int(new))
+                m.breaker_transitions.labels(ep.target, new.label).inc()
+
+        return sink
 
     def _probe_one(self, ep: _Endpoint) -> bool:
         """One Status probe. Returns False only on TRANSPORT failure —
@@ -158,17 +250,29 @@ class BlsOffloadClient(IBlsVerifier):
             out = ep.status(b"", timeout=timeout)
             frame = decode_status(out)
         except (grpc.RpcError, OffloadError):
-            ep.healthy = False
+            with self._lock:
+                ep.healthy = False
             return False
         # transport up; the binary gate keeps the old health semantics
-        # (a server that REJECTs everything counts as not-accepting)
-        if not ep.healthy and frame.can_accept:
+        # (a server that REJECTs everything counts as not-accepting).
+        # A transport RECOVERY (failed probes, then success) releases an
+        # open breaker's reset wait: the next verify becomes the
+        # half-open trial immediately, so a restarted server is
+        # re-adopted within one probe interval. A probe that never
+        # failed is NOT recovery evidence — a gray-failing server
+        # (Status up, verify sick) must keep its exponential trial
+        # schedule, not get a fresh trial per probe interval.
+        if ep.consecutive_failures > 0:
+            ep.breaker.note_probe_success()
+        with self._lock:
+            was_healthy = ep.healthy
+            ep.healthy = frame.can_accept
+            ep.admission = frame.admission
+            ep.occupancy_permille = frame.occupancy_permille
+            ep.queue_depth = frame.queue_depth
+            ep.extended = frame.extended
+        if not was_healthy and frame.can_accept:
             self.log.info(f"offload service {ep.target} is back")
-        ep.healthy = frame.can_accept
-        ep.admission = frame.admission
-        ep.occupancy_permille = frame.occupancy_permille
-        ep.queue_depth = frame.queue_depth
-        ep.extended = frame.extended
         return True
 
     def _probe_loop(self) -> None:
@@ -178,7 +282,8 @@ class BlsOffloadClient(IBlsVerifier):
         refresh every probe_interval_s, failed ones back off individually
         — so one dead endpoint's probe timeouts neither stall the healthy
         endpoints' occupancy refresh nor get re-dialed ahead of their
-        backoff."""
+        backoff. close() sets `_wake`, so the loop exits promptly instead
+        of sleeping out the interval against a closed channel."""
         # indexed by endpoint position: duplicate targets stay independent
         next_at = [0.0] * len(self._endpoints)
         while not self._closed:
@@ -204,41 +309,72 @@ class BlsOffloadClient(IBlsVerifier):
             if self._closed:
                 return
             wake = min(next_at) - time.monotonic()
-            time.sleep(min(self.probe_interval_s, max(0.02, wake)))
+            self._wake.wait(min(self.probe_interval_s, max(0.02, wake)))
 
     # -- routing ---------------------------------------------------------------
 
-    def _pick_endpoint(self, priority: PriorityClass) -> _Endpoint:
-        """Least-occupied healthy endpoint whose admission state admits
-        this class; bulk work skips SHED_BULK servers while any endpoint
-        still ACCEPTs. Degrades to any-healthy, then to the primary (the
-        verify RPC then fails closed on its own)."""
+    def _pick_endpoint(
+        self, priority: PriorityClass, exclude: tuple[_Endpoint, ...] = ()
+    ) -> _Endpoint | None:
+        """Least-occupied closed-breaker healthy endpoint whose admission
+        state admits this class; bulk work skips SHED_BULK servers while
+        any endpoint still ACCEPTs. Degrades to any-healthy, then to any
+        closed-breaker endpoint (the verify RPC then fails closed on its
+        own). Endpoints whose breaker is open are skipped WITHOUT dialing
+        — when none is closed, at most one half-open trial is admitted;
+        None means every endpoint is circuit-open (caller fails fast and
+        the degradation chain takes over).
+
+        Recovery: an OPEN endpoint whose reset delay elapsed gets its
+        half-open trial EVEN while closed endpoints exist — otherwise a
+        briefly-dead endpoint stays circuit-open forever once a sibling
+        absorbs all traffic. The breaker's exponential schedule caps the
+        cost at one trial request per reset window, only probe-healthy
+        endpoints are trialed, and only a first-attempt HEDGE-class
+        request is spent as the canary — it retries on a known-good
+        endpoint if the trial fails, so no caller-visible error is
+        burned on probing (non-hedge classes still trial when no closed
+        endpoint exists at all, where there is nothing to lose)."""
         with self._lock:
-            eps = self._endpoints
-            if len(eps) == 1:
-                return eps[0]
-            healthy = [ep for ep in eps if ep.healthy]
-            cands = [ep for ep in healthy if ep.admission is not AdmissionState.REJECT]
-            if priority in BULK_CLASSES:
-                accepting = [ep for ep in cands if ep.admission is AdmissionState.ACCEPT]
-                if accepting:
-                    cands = accepting
-            if not cands:
-                cands = healthy or eps
-            return min(
-                cands,
-                key=lambda ep: (
-                    ep.occupancy_permille
-                    if ep.occupancy_permille is not None
-                    else _UNKNOWN_OCCUPANCY,
-                    ep.outstanding,
-                ),
-            )
+            pool = [ep for ep in self._endpoints if ep not in exclude]
+            if not pool:
+                return None
+            closed = [ep for ep in pool if ep.breaker.state() is BreakerState.CLOSED]
+            if not exclude and priority in self._hedge_classes and len(closed) < len(pool):
+                for ep in pool:
+                    if (
+                        ep not in closed
+                        and ep.healthy
+                        and ep.breaker.seconds_until_trial() == 0.0
+                        and ep.breaker.try_acquire()
+                    ):
+                        return ep
+            if closed:
+                healthy = [ep for ep in closed if ep.healthy]
+                cands = [ep for ep in healthy if ep.admission is not AdmissionState.REJECT]
+                if priority in BULK_CLASSES:
+                    accepting = [
+                        ep for ep in cands if ep.admission is AdmissionState.ACCEPT
+                    ]
+                    if accepting:
+                        cands = accepting
+                if not cands:
+                    cands = healthy or closed
+                return min(cands, key=_occupancy_key)
+            # no closed breaker left: probe the least-loaded endpoint that
+            # admits a half-open trial (try_acquire consumes the slot)
+            for ep in sorted(pool, key=_occupancy_key):
+                if ep.breaker.try_acquire():
+                    return ep
+            return None
 
     def endpoint_states(self) -> list[dict]:
         """Probe-refreshed view per endpoint (debugging/metrics/tests)."""
         with self._lock:
             return [ep.state() for ep in self._endpoints]
+
+    def _deadline_for(self, priority: PriorityClass) -> float:
+        return deadline_for(priority, cap=self.timeout_s, deadlines=self._class_deadlines)
 
     # -- IBlsVerifier ----------------------------------------------------------
 
@@ -246,7 +382,10 @@ class BlsOffloadClient(IBlsVerifier):
         self, sets: list[SignatureSet], opts: VerifySignatureOpts | None = None
     ) -> bool:
         """One RPC per job; blocking stub call moved off the event loop.
-        Raises OffloadError on transport/server error (fail closed)."""
+        Raises OffloadError on transport/server error (fail closed). The
+        RPC deadline is the class budget; hedge-class work that fails on
+        its first endpoint retries ONCE on a different one before the
+        error propagates (to the degradation chain, when configured)."""
         frame = encode_sets(list(sets))
         n_sets = len(sets)
         priority = (
@@ -254,85 +393,192 @@ class BlsOffloadClient(IBlsVerifier):
             if opts is not None and opts.priority is not None
             else PriorityClass.API
         )
-        ep = self._pick_endpoint(priority)
+        deadline = self._deadline_for(priority)
         # trace context rides the call's metadata so server-side device
         # spans come home in trailing metadata and stitch under this RPC;
         # captured here because the executor thread has no contextvars
         trace_hdr = tracing.context_header()
         trace_parent = tracing.current()
 
-        def call() -> bool:
-            # clock reads only on the traced path: untraced RPCs pay just
-            # the trace_hdr None-checks
-            t0 = time.monotonic_ns() if trace_hdr is not None else 0
-            grpc_call = None
-            err: str | None = None
-            try:
-                if trace_hdr is not None:
-                    resp, grpc_call = ep.verify.with_call(
-                        frame,
-                        timeout=self.timeout_s,
-                        metadata=((tracing.TRACE_CONTEXT_KEY, trace_hdr),),
-                    )
-                else:
-                    resp = ep.verify(frame, timeout=self.timeout_s)
-                # may raise OffloadError: the server answered with an
-                # error frame (backend failure) — trailing spans still
-                # came home and must be grafted below
-                verdict = decode_verdict(resp)
-                ep.healthy = True
-                return verdict
-            except grpc.RpcError as e:
-                err = str(e.code())
-                ep.healthy = False  # probe loop takes over reconnection
-                raise OffloadError(f"offload transport: {e.code()}") from e
-            except OffloadError as e:
-                err = str(e)[:120]
-                raise
-            finally:
-                # the RPC span is recorded on EVERY exit path — a failing
-                # slot's trace is exactly the one that needs its offload leg
-                if trace_hdr is not None:
-                    attrs = {
-                        "sets": n_sets,
-                        "target": ep.target,
-                        "class": priority.label,
-                    }
-                    if err is not None:
-                        attrs["error"] = err
-                    rpc_span = tracing.record(
-                        trace_parent, "offload_rpc", t0, time.monotonic_ns(), attrs
-                    )
-                    if grpc_call is not None:
-                        try:
-                            for k, v in grpc_call.trailing_metadata() or ():
-                                if k == tracing.TRACE_SPANS_KEY:
-                                    tracing.graft_remote_spans(rpc_span, v, t0)
-                        except Exception:
-                            pass  # tracing must never mask the verdict/error
-
+        # hedge only when a second endpoint is actually USABLE right now
+        # — splitting the budget against a circuit-open sibling would
+        # halve the only viable attempt's deadline for nothing
         with self._lock:
-            self._outstanding += 1
-            ep.outstanding += 1
-        try:
-            return await asyncio.get_event_loop().run_in_executor(None, call)
-        finally:
+            usable = sum(
+                1 for ep in self._endpoints if ep.healthy and not ep.breaker.is_open
+            )
+        max_attempts = 2 if priority in self._hedge_classes and usable > 1 else 1
+        tried: tuple[_Endpoint, ...] = ()
+        last_err: OffloadError | None = None
+        loop = asyncio.get_event_loop()
+        t_start = time.monotonic()
+        for attempt in range(max_attempts):
+            # the class budget covers ALL attempts — a slow-but-alive
+            # first endpoint must not double the stated slot-deadline
+            # bound. The first attempt gets an equal share; a later one
+            # gets whatever the earlier left (a fast transport failure
+            # donates its unused share to the hedge).
+            remaining = deadline - (time.monotonic() - t_start)
+            if remaining <= 0:
+                break
+            attempt_deadline = min(deadline / max_attempts, remaining) if attempt == 0 else remaining
+            ep = self._pick_endpoint(priority, exclude=tried)
+            if ep is None:
+                break
+            tried = tried + (ep,)
+            if attempt > 0:
+                self._note_hedge(tried[0], ep, priority, trace_parent)
+            m = self._metrics
+            if m is not None:
+                m.routed.labels(ep.target).inc()
             with self._lock:
-                self._outstanding -= 1
-                ep.outstanding -= 1
+                self._outstanding += 1
+                ep.outstanding += 1
+            try:
+                verdict = await loop.run_in_executor(
+                    None,
+                    self._call_endpoint,
+                    ep, frame, n_sets, priority, attempt_deadline, trace_hdr, trace_parent,
+                )
+                if attempt > 0 and m is not None:
+                    m.hedge_wins.labels(priority.label).inc()
+                return verdict
+            except OffloadError as e:
+                last_err = e
+                if m is not None:
+                    m.failovers.labels(ep.target).inc()
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+                    ep.outstanding -= 1
+        if last_err is not None:
+            raise last_err
+        raise OffloadError("no offload endpoint admits work (all breakers open)")
+
+    def _note_hedge(
+        self, first: _Endpoint, second: _Endpoint, priority: PriorityClass, trace_parent
+    ) -> None:
+        self.log.info(
+            "offload hedge retry",
+            {"from": first.target, "to": second.target, "class": priority.label},
+        )
+        if self._metrics is not None:
+            self._metrics.hedges.labels(priority.label).inc()
+        if trace_parent is not None:
+            now = time.monotonic_ns()
+            tracing.record(
+                trace_parent, "offload_hedge", now, now,
+                {"from": first.target, "to": second.target, "class": priority.label},
+            )
+
+    def _call_endpoint(
+        self,
+        ep: _Endpoint,
+        frame: bytes,
+        n_sets: int,
+        priority: PriorityClass,
+        deadline: float,
+        trace_hdr,
+        trace_parent,
+    ) -> bool:
+        """One verify RPC on `ep` (runs on an executor thread). Breaker
+        outcome and endpoint health are recorded on every exit path."""
+        # clock reads only on the traced path: untraced RPCs pay just
+        # the trace_hdr None-checks
+        t0 = time.monotonic_ns() if trace_hdr is not None else 0
+        grpc_call = None
+        err: str | None = None
+        try:
+            if trace_hdr is not None:
+                resp, grpc_call = ep.verify.with_call(
+                    frame,
+                    timeout=deadline,
+                    metadata=((tracing.TRACE_CONTEXT_KEY, trace_hdr),),
+                )
+            else:
+                resp = ep.verify(frame, timeout=deadline)
+            # may raise OffloadError: server error frame, malformed frame,
+            # or a digest that doesn't bind this request to this verdict —
+            # trailing spans still came home and must be grafted below
+            verdict = decode_verdict(resp, request=frame, require_digest=ep.digest_seen)
+            ep.breaker.record_success()
+            with self._lock:
+                ep.healthy = True
+                if len(resp) > 1:
+                    ep.digest_seen = True
+            return verdict
+        except grpc.RpcError as e:
+            err = str(e.code())
+            ep.breaker.record_failure()
+            with self._lock:
+                ep.healthy = False  # probe loop takes over reconnection
+            raise OffloadError(f"offload transport: {e.code()}") from e
+        except OffloadError as e:
+            err = str(e)[:120]
+            # a server answering with error/corrupt frames is sick even
+            # though its transport is up: count toward the breaker
+            ep.breaker.record_failure()
+            raise
+        except Exception as e:
+            # anything else (e.g. 'Cannot invoke RPC on closed channel'
+            # racing a probe-thread reconnect) MUST still resolve the
+            # breaker outcome — a leaked half-open trial slot would
+            # blacklist the endpoint forever — and fails closed like
+            # every other offload error
+            err = f"{type(e).__name__}: {e}"[:120]
+            ep.breaker.record_failure()
+            raise OffloadError(err) from e
+        finally:
+            # the RPC span is recorded on EVERY exit path — a failing
+            # slot's trace is exactly the one that needs its offload leg
+            if trace_hdr is not None:
+                attrs = {
+                    "sets": n_sets,
+                    "target": ep.target,
+                    "class": priority.label,
+                    "deadline_s": deadline,
+                }
+                if err is not None:
+                    attrs["error"] = err
+                rpc_span = tracing.record(
+                    trace_parent, "offload_rpc", t0, time.monotonic_ns(), attrs
+                )
+                if grpc_call is not None:
+                    try:
+                        for k, v in grpc_call.trailing_metadata() or ():
+                            if k == tracing.TRACE_SPANS_KEY:
+                                tracing.graft_remote_spans(rpc_span, v, t0)
+                    except Exception:
+                        pass  # tracing must never mask the verdict/error
+
+    def is_down(self) -> bool:
+        """True when NO endpoint is viable (unhealthy or circuit-open) —
+        the degradation chain's signal to route around this layer.
+        Distinct from `can_accept_work`: a saturated-but-alive client is
+        NOT down (the processor should shed, not silently degrade every
+        gossip verify onto a slower fallback layer)."""
+        if self._closed:
+            return True
+        return not any(ep.healthy and not ep.breaker.is_open for ep in self._endpoints)
 
     def can_accept_work(self) -> bool:
         """RPC-free admission: in-process outstanding-job counter below the
-        cap AND some endpoint's cached health (background probe). Sheds
-        load rather than queueing against dead or saturated services. The
-        cap is per endpoint (reference MAX_JOBS per pool), so adding
-        offload servers adds admitted concurrency."""
+        cap AND some endpoint both probe-healthy and not circuit-open.
+        Sheds load rather than queueing against dead or saturated
+        services. The cap is per endpoint (reference MAX_JOBS per pool),
+        so adding offload servers adds admitted concurrency."""
         if self._outstanding >= self.max_outstanding * len(self._endpoints):
             return False
-        return any(ep.healthy for ep in self._endpoints)
+        return not self.is_down()
 
     async def close(self) -> None:
         self._closed = True
+        self._wake.set()
+        probe = self._probe_thread
+        if probe.is_alive() and probe is not threading.current_thread():
+            # probe RPC timeouts are <= 2s, so the join is bounded; run it
+            # off the event loop
+            await asyncio.get_event_loop().run_in_executor(None, probe.join, 5.0)
         for ep in self._endpoints:
             try:
                 ep.channel.close()

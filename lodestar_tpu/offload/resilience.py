@@ -1,0 +1,254 @@
+"""Offload resilience primitives: circuit breakers + deadline budgets.
+
+The offload leg fails CLOSED (`client.py`): any transport error rejects
+the verification, so a dead or flapping accelerator host turns into
+rejected-but-valid blocks until the probe loop notices. Two primitives
+bound that damage window:
+
+* `CircuitBreaker` — per-endpoint closed → open → half-open state
+  machine. Consecutive verify failures open the breaker; the hot path
+  then skips the endpoint immediately (no dial, no timeout wait)
+  instead of paying a full RPC deadline per block while the 2s probe
+  loop catches up. After an exponential-with-jitter reset delay
+  (`utils.backoff_delay`) ONE trial request is admitted (half-open);
+  success closes the breaker, failure re-opens it with a longer delay.
+  A successful Status probe releases the open-wait early — transport
+  recovery observed out-of-band grants a trial immediately.
+
+* `deadline_for` — class-aware RPC deadline budgets replacing the flat
+  30s timeout. A `GOSSIP_BLOCK` verification that hasn't answered in
+  2s is useless (the slot deadline is burning) and should fail over /
+  hedge to another endpoint; a backfill batch can happily wait 30s.
+  The committee-consensus measurements in PAPERS.md make the same
+  point: once verification is outsourced, the tail of the offload RPC
+  IS the tail of block import.
+
+Dependency-light by design: imports only stdlib + scheduler + utils, so
+`chain/bls` (device-pool wedge detection) and `offload/client.py` both
+use it without cycles.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable
+
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.utils import backoff_delay
+
+__all__ = [
+    "BreakerState",
+    "CircuitBreaker",
+    "CLASS_DEADLINE_S",
+    "HEDGE_CLASSES",
+    "DEFAULT_FAILURE_THRESHOLD",
+    "DEFAULT_RESET_TIMEOUT_S",
+    "DEFAULT_MAX_RESET_TIMEOUT_S",
+    "deadline_for",
+]
+
+#: breaker defaults — the ONE definition; the client, node options and
+#: CLI all reference these. Threshold tuned so one flaky RPC doesn't
+#: open the breaker (hedges + the degradation chain absorb singles) but
+#: a dead host opens within one gossip burst.
+DEFAULT_FAILURE_THRESHOLD = 5
+DEFAULT_RESET_TIMEOUT_S = 2.0
+DEFAULT_MAX_RESET_TIMEOUT_S = 30.0
+
+
+class BreakerState(enum.IntEnum):
+    """Gauge-friendly encoding: 0 closed / 1 half-open / 2 open."""
+
+    CLOSED = 0
+    HALF_OPEN = 1
+    OPEN = 2
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+
+#: per-launch-class RPC deadline budget (seconds), covering ALL attempts
+#: — the client splits it across the hedged retry, so GOSSIP_BLOCK's 2s
+#: bounds the whole verification leg well inside the 4s attestation
+#: deadline; bulk classes keep the old generous flat timeout.
+CLASS_DEADLINE_S: dict[PriorityClass, float] = {
+    PriorityClass.GOSSIP_BLOCK: 2.0,
+    PriorityClass.GOSSIP_ATTESTATION: 4.0,
+    PriorityClass.API: 10.0,
+    PriorityClass.RANGE_SYNC: 30.0,
+    PriorityClass.BACKFILL: 30.0,
+}
+
+#: classes whose failed RPC is retried once on a second healthy endpoint
+#: (the deadline budget covers two attempts; bulk work just fails over
+#: to the degradation chain / next submission instead)
+HEDGE_CLASSES = frozenset({PriorityClass.GOSSIP_BLOCK, PriorityClass.GOSSIP_ATTESTATION})
+
+
+def deadline_for(
+    priority: PriorityClass,
+    *,
+    cap: float | None = None,
+    deadlines: dict[PriorityClass, float] | None = None,
+) -> float:
+    """The RPC deadline for one attempt of `priority`-class work, capped
+    at `cap` (a caller-configured flat timeout stays an upper bound so
+    explicit tight timeouts — e.g. tests against dead endpoints — win)."""
+    d = (deadlines or CLASS_DEADLINE_S).get(priority, CLASS_DEADLINE_S[PriorityClass.API])
+    if cap is not None:
+        d = min(d, cap)
+    return d
+
+
+class CircuitBreaker:
+    """Closed → open → half-open breaker, thread-safe.
+
+    All three client threads touch it (event-loop hot path via executor
+    workers, the probe thread, tests' manual clocks), so every state
+    read/write holds the internal lock. `on_transition(old, new)` fires
+    outside the lock — metric/log sinks must not re-enter.
+    """
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+        reset_timeout_s: float = DEFAULT_RESET_TIMEOUT_S,
+        max_reset_timeout_s: float = DEFAULT_MAX_RESET_TIMEOUT_S,
+        jitter: float = 0.1,
+        clock: Callable[[], float] = time.monotonic,
+        on_transition: Callable[[BreakerState, BreakerState], None] | None = None,
+    ) -> None:
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.max_reset_timeout_s = max_reset_timeout_s
+        self.jitter = jitter
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = BreakerState.CLOSED
+        self._failures = 0  # consecutive, resets on success
+        self._open_streak = 0  # consecutive opens -> exponential reset delay
+        self._retry_at = 0.0
+        self._trial_inflight = False
+
+    # -- queries ---------------------------------------------------------------
+
+    def state(self) -> BreakerState:
+        with self._lock:
+            return self._state
+
+    @property
+    def is_open(self) -> bool:
+        """True while the breaker refuses NEW work outright (open and
+        the reset delay has not elapsed). Cheap routing predicate — does
+        not mutate state or consume the half-open trial slot."""
+        with self._lock:
+            if self._state is BreakerState.CLOSED:
+                return False
+            if self._state is BreakerState.HALF_OPEN:
+                return self._trial_inflight
+            return self._clock() < self._retry_at
+
+    def seconds_until_trial(self) -> float:
+        with self._lock:
+            if self._state is not BreakerState.OPEN:
+                return 0.0
+            return max(0.0, self._retry_at - self._clock())
+
+    # -- admission -------------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        """May a request be issued now? CLOSED always admits. OPEN past
+        its reset delay flips to HALF_OPEN and admits exactly one trial;
+        the trial slot is held until record_success/record_failure."""
+        fire: tuple[BreakerState, BreakerState] | None = None
+        with self._lock:
+            if self._state is BreakerState.CLOSED:
+                return True
+            if self._state is BreakerState.OPEN and self._clock() >= self._retry_at:
+                fire = (self._state, BreakerState.HALF_OPEN)
+                self._state = BreakerState.HALF_OPEN
+                self._trial_inflight = True
+            elif self._state is BreakerState.HALF_OPEN and not self._trial_inflight:
+                self._trial_inflight = True
+                return True
+            else:
+                return False
+        self._emit(fire)
+        return True
+
+    # -- outcomes --------------------------------------------------------------
+
+    def record_success(self) -> None:
+        fire: tuple[BreakerState, BreakerState] | None = None
+        with self._lock:
+            self._failures = 0
+            if self._state is BreakerState.OPEN and self._clock() < self._retry_at:
+                # a STALE success: an RPC issued before the breaker
+                # opened, landing inside the reset window. Not trial
+                # evidence — closing here would resume full traffic to a
+                # host that just produced `failure_threshold` consecutive
+                # failures. (Past the window it IS trial-equivalent: the
+                # pool gates on is_open alone and never runs try_acquire.)
+                return
+            self._trial_inflight = False
+            if self._state is not BreakerState.CLOSED:
+                fire = (self._state, BreakerState.CLOSED)
+                self._state = BreakerState.CLOSED
+                self._open_streak = 0
+        self._emit(fire)
+
+    def record_failure(self) -> None:
+        fire: tuple[BreakerState, BreakerState] | None = None
+        with self._lock:
+            self._trial_inflight = False
+            self._failures += 1
+            # a failure while OPEN past the reset delay is a failed trial
+            # too: callers that gate on is_open alone (the pool's wedge
+            # check never calls try_acquire) let work through once the
+            # delay elapses — without re-arming here the breaker would
+            # stop gating forever after its first reset window
+            should_open = (
+                self._state is BreakerState.HALF_OPEN
+                or (self._state is BreakerState.OPEN and self._clock() >= self._retry_at)
+                or (
+                    self._state is BreakerState.CLOSED
+                    and self._failures >= self.failure_threshold
+                )
+            )
+            if should_open:
+                if self._state is not BreakerState.OPEN:
+                    fire = (self._state, BreakerState.OPEN)
+                delay = backoff_delay(
+                    self._open_streak,
+                    base=self.reset_timeout_s,
+                    max_delay=self.max_reset_timeout_s,
+                    jitter=self.jitter,
+                )
+                self._open_streak += 1
+                self._state = BreakerState.OPEN
+                self._retry_at = self._clock() + delay
+        self._emit(fire)
+
+    def note_probe_success(self) -> None:
+        """Out-of-band evidence the endpoint is back (a Status probe
+        answered): release the open-wait so the next verify becomes the
+        half-open trial instead of sitting out the full reset delay."""
+        with self._lock:
+            if self._state is BreakerState.OPEN:
+                self._retry_at = self._clock()
+
+    # -- internals -------------------------------------------------------------
+
+    def _emit(self, fire: tuple[BreakerState, BreakerState] | None) -> None:
+        if fire is not None and self._on_transition is not None:
+            try:
+                self._on_transition(*fire)
+            except Exception:
+                pass  # metric/log sink errors must never affect admission

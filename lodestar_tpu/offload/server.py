@@ -121,7 +121,9 @@ class BlsOffloadServer:
             with rec.span("offload_device_verify", sets=len(sets)):
                 with self.occupancy.launch():
                     ok = bool(self.backend(sets))
-            out = encode_verdict(ok)
+            # digest-checked verdict: binds this reply to this request
+            # frame so corruption/splicing fails closed at the client
+            out = encode_verdict(ok, request=request)
         except Exception as e:  # error frame, not a transport abort
             self.log.warn("verify job failed", {"error": str(e)})
             out = encode_verdict(None, error=f"{type(e).__name__}: {e}")

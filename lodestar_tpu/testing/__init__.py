@@ -1,0 +1,10 @@
+"""Deterministic test harnesses for the node's failure paths.
+
+`faults` is the fault-injection seam the chaos suite drives through the
+offload client/server and the verify backend — seeded, scheduled fault
+delivery so every chaos run is reproducible from its seed.
+"""
+
+from .faults import FaultInjector, FaultKind, FaultRule  # noqa: F401
+
+__all__ = ["FaultInjector", "FaultKind", "FaultRule"]

@@ -9,6 +9,7 @@ bytes.ts, math.ts). Merkle-branch verification lives in
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from typing import Awaitable, Callable, TypeVar
 
@@ -16,6 +17,7 @@ T = TypeVar("T")
 
 __all__ = [
     "sleep",
+    "backoff_delay",
     "retry",
     "retry_sync",
     "bytes_to_int",
@@ -87,14 +89,64 @@ async def sleep(seconds: float) -> None:
     await asyncio.sleep(seconds)
 
 
+def backoff_delay(
+    attempt: int,
+    *,
+    base: float,
+    factor: float = 2.0,
+    max_delay: float | None = None,
+    jitter: float = 0.0,
+    rng: Callable[[], float] = random.random,
+) -> float:
+    """Delay before retry number `attempt` (0-based): exponential
+    `base * factor**attempt`, capped at `max_delay`, with up to
+    `jitter` fraction of the capped delay SUBTRACTED (jitter spreads a
+    fleet of breakers opened by the same outage so they don't re-probe
+    the recovering host in lockstep — downward, so the documented cap
+    is a true upper bound even at saturation, where upward jitter
+    would both exceed it and collapse back into lockstep). Used by
+    utils.retry's backoff mode and the offload circuit breaker's
+    half-open schedule."""
+    if attempt < 0:
+        raise ValueError("attempt must be >= 0")
+    delay = base * (factor ** attempt)
+    if max_delay is not None:
+        delay = min(delay, max_delay)
+    if jitter:
+        delay -= delay * jitter * rng()
+    return delay
+
+
+def _retry_delay_for(
+    attempt: int,
+    retry_delay: float,
+    backoff_factor: float | None,
+    max_delay: float | None,
+    jitter: float,
+) -> float:
+    """Fixed delay unless a backoff factor is given (keeps every
+    existing fixed-delay caller's behavior bit-for-bit)."""
+    if backoff_factor is None:
+        return retry_delay
+    return backoff_delay(
+        attempt, base=retry_delay, factor=backoff_factor, max_delay=max_delay, jitter=jitter
+    )
+
+
 async def retry(
     fn: Callable[[], Awaitable[T]],
     *,
     retries: int = 3,
     retry_delay: float = 0.0,
+    backoff_factor: float | None = None,
+    max_delay: float | None = None,
+    jitter: float = 0.0,
     should_retry: Callable[[Exception], bool] | None = None,
 ) -> T:
-    """Async retry with fixed delay (reference `utils/src/retry.ts`).
+    """Async retry (reference `utils/src/retry.ts`). Default is the
+    reference's fixed delay; passing `backoff_factor` switches to
+    exponential backoff (`retry_delay * factor**attempt`) with an
+    optional `max_delay` cap and `jitter` fraction.
 
     Only `Exception` is retried: cancellation (CancelledError) and
     KeyboardInterrupt propagate immediately.
@@ -110,7 +162,9 @@ async def retry(
                 raise
             last = e
             if attempt < retries - 1 and retry_delay:
-                await asyncio.sleep(retry_delay)
+                await asyncio.sleep(
+                    _retry_delay_for(attempt, retry_delay, backoff_factor, max_delay, jitter)
+                )
     assert last is not None
     raise last
 
@@ -120,6 +174,9 @@ def retry_sync(
     *,
     retries: int = 3,
     retry_delay: float = 0.0,
+    backoff_factor: float | None = None,
+    max_delay: float | None = None,
+    jitter: float = 0.0,
     should_retry: Callable[[Exception], bool] | None = None,
 ) -> T:
     if retries < 1:
@@ -133,7 +190,9 @@ def retry_sync(
                 raise
             last = e
             if attempt < retries - 1 and retry_delay:
-                time.sleep(retry_delay)
+                time.sleep(
+                    _retry_delay_for(attempt, retry_delay, backoff_factor, max_delay, jitter)
+                )
     assert last is not None
     raise last
 

@@ -27,3 +27,10 @@ jax.config.update("jax_platforms", "cpu")
 from lodestar_tpu.utils import enable_compile_cache  # noqa: E402
 
 enable_compile_cache(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def pytest_configure(config):
+    # tier-1 deselects these via `-m 'not slow'` (ROADMAP verify line)
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (chaos soaks) excluded from tier-1"
+    )

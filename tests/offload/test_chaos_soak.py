@@ -1,0 +1,184 @@
+"""Long-running chaos soak (marked slow, excluded from tier-1): a
+seeded probabilistic storm of every fault class against a two-endpoint
+offload deployment fronted by the degradation chain. Invariants:
+
+* no iteration EVER resolves True while the backends deem sets invalid
+* the degradation chain keeps availability: every iteration that does
+  not error fail-closed still produces a (False) verdict
+* after heal(), the system recovers — offload serves again and the
+  breakers re-close
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls import BlsSingleThreadVerifier, DegradingBlsVerifier
+from lodestar_tpu.chain.bls.interface import IBlsVerifier, VerifySignatureOpts
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.testing import FaultInjector, FaultKind, FaultRule
+
+pytestmark = pytest.mark.slow
+
+SOAK_ITERATIONS = 300
+SEED = 20260803
+
+
+def _dummy_sets(n: int = 2) -> list[SignatureSet]:
+    return [
+        SignatureSet(pubkey=bytes([i + 1]) * 48, message=bytes([i]) * 32, signature=bytes([i]) * 96)
+        for i in range(n)
+    ]
+
+
+class _AlwaysFalseCpu(IBlsVerifier):
+    """Terminal layer for the soak: the 'oracle' verdict for these
+    opaque sets is invalid — so ANY True from the stack is a soundness
+    break, whatever path produced it."""
+
+    async def verify_signature_sets(self, sets, opts=None) -> bool:
+        return False
+
+    def can_accept_work(self) -> bool:
+        return True
+
+    async def close(self) -> None:
+        return None
+
+
+_STORM = [
+    FaultRule(FaultKind.RESET, probability=0.08, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.LATENCY, probability=0.10, delay_s=0.01, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.DEADLINE, probability=0.08, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.UNAVAILABLE, probability=0.10, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.ERROR_FRAME, probability=0.08, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.CORRUPT_VERDICT, probability=0.10, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.FLIP_VERDICT, probability=0.10, methods=frozenset({"verify"})),
+    # the probe path sees weather too
+    FaultRule(FaultKind.UNAVAILABLE, probability=0.10, methods=frozenset({"status"})),
+]
+
+_PRIORITIES = [
+    PriorityClass.GOSSIP_BLOCK,
+    PriorityClass.GOSSIP_ATTESTATION,
+    PriorityClass.API,
+    PriorityClass.RANGE_SYNC,
+    PriorityClass.BACKFILL,
+]
+
+
+def test_chaos_soak_invariant_and_recovery():
+    server_a = BlsOffloadServer(lambda s: False, port=0)
+    server_b = BlsOffloadServer(lambda s: False, port=0)
+    server_a.start()
+    server_b.start()
+    A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+    inj = FaultInjector(_STORM, seed=SEED)
+    client = BlsOffloadClient(
+        [A, B],
+        breaker_threshold=3,
+        breaker_reset_s=0.02,
+        breaker_max_reset_s=0.2,
+        probe_interval_s=0.1,
+        transport_wrapper=inj.wrap_transport,
+    )
+    deg = DegradingBlsVerifier([("offload", client), ("cpu", _AlwaysFalseCpu())])
+
+    verdicts = {"false": 0, "error": 0}
+    storm_kinds = {r.kind for r in _STORM}
+    try:
+
+        async def soak():
+            # soak at least SOAK_ITERATIONS; keep going (bounded) until
+            # every storm class has provably fired — the probabilistic
+            # draws interleave with hedges and the probe thread, so a
+            # fixed count would flake
+            i = 0
+            while i < SOAK_ITERATIONS or (
+                i < 5 * SOAK_ITERATIONS
+                and any(inj.injected[k] < 1 for k in storm_kinds)
+            ):
+                opts = VerifySignatureOpts(priority=int(_PRIORITIES[i % len(_PRIORITIES)]))
+                try:
+                    v = await deg.verify_signature_sets(_dummy_sets(), opts)
+                except Exception:
+                    verdicts["error"] += 1
+                else:
+                    assert v is False, f"iteration {i}: invalid sets resolved True"
+                    verdicts["false"] += 1
+                # pace the loop so breaker reset windows elapse and the
+                # offload leg keeps re-engaging (this is a soak, not a
+                # tight-loop benchmark)
+                await asyncio.sleep(0.005)
+                i += 1
+            # mid-soak hard partition of everything: availability must
+            # hold through the terminal layer, soundness must hold period
+            inj.partition("*")
+            import time as _time
+
+            part_deadline = _time.monotonic() + 3.0
+            n = 0
+            # at least 30 partitioned imports; keep going until a
+            # half-open trial actually dialed into the partition (the
+            # breaker reset windows are 0.02-0.2s, well inside 3s)
+            while n < 30 or (
+                inj.injected[FaultKind.PARTITION] < 1 and _time.monotonic() < part_deadline
+            ):
+                v = await deg.verify_signature_sets(_dummy_sets())
+                assert v is False
+                await asyncio.sleep(0.01)
+                n += 1
+            inj.heal("*")
+
+        asyncio.run(soak())
+
+        # the storm actually stormed (every class fired at least once)
+        for kind in (
+            FaultKind.LATENCY,
+            FaultKind.DEADLINE,
+            FaultKind.UNAVAILABLE,
+            FaultKind.RESET,
+            FaultKind.ERROR_FRAME,
+            FaultKind.CORRUPT_VERDICT,
+            FaultKind.FLIP_VERDICT,
+            FaultKind.PARTITION,
+        ):
+            assert inj.injected[kind] >= 1, f"{kind} never fired in the soak"
+        # the degradation chain kept availability: far more verdicts than
+        # hard failures (only an all-layer error surfaces as one)
+        assert verdicts["false"] > verdicts["error"]
+        assert verdicts["false"] >= SOAK_ITERATIONS // 2
+
+        # recovery: with the weather cleared, offload serves again and
+        # the breakers re-close. The probe's reconnect backoff caps at
+        # 8s, so recovery is observable within one capped backoff cycle.
+        async def recover():
+            import time as _time
+
+            inj.rules.clear()  # end the storm
+            deadline = _time.monotonic() + 15.0
+            # hedge-class traffic: re-adopting a still-open endpoint
+            # while its sibling is closed spends a hedge-capable request
+            # as the half-open trial (gossip is the dominant class on a
+            # real node, so this is also the realistic recovery path)
+            opts = VerifySignatureOpts(priority=int(PriorityClass.GOSSIP_BLOCK))
+            while _time.monotonic() < deadline:
+                v = await deg.verify_signature_sets(_dummy_sets(), opts)
+                assert v is False
+                if deg.last_layer == "offload" and all(
+                    s["breaker"] == "closed" for s in client.endpoint_states()
+                ):
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        assert asyncio.run(recover()), "offload layer did not recover after heal"
+    finally:
+        asyncio.run(deg.close())
+        server_a.stop()
+        server_b.stop()

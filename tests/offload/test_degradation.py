@@ -1,0 +1,299 @@
+"""Verified degradation chain: layer semantics (errors degrade, verdicts
+are final, every layer re-verifies) and the acceptance invariant — with
+every offload endpoint partitioned, a block still imports through the
+chain inside its slot deadline, and an invalid block still rejects."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from lodestar_tpu import params
+from lodestar_tpu.chain.bls import (
+    BlsSingleThreadVerifier,
+    BlsVerifierMock,
+    DegradingBlsVerifier,
+)
+from lodestar_tpu.chain.bls.interface import IBlsVerifier, VerifySignatureOpts
+from lodestar_tpu.chain.bls.pool import BlsDeviceVerifierPool, DEVICE_WEDGE_THRESHOLD
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_sets
+from lodestar_tpu.metrics import create_metrics
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.state_transition.genesis import interop_secret_keys
+from lodestar_tpu.testing import FaultInjector
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_preset():
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    yield params.active_preset()
+    params.set_active_preset(prev)
+
+
+class _ErroringVerifier(IBlsVerifier):
+    def __init__(self, accepting: bool = True):
+        self.accepting = accepting
+        self.calls = 0
+
+    async def verify_signature_sets(self, sets, opts=None) -> bool:
+        self.calls += 1
+        raise RuntimeError("layer down")
+
+    def is_down(self) -> bool:
+        return not self.accepting
+
+    def can_accept_work(self) -> bool:
+        return self.accepting
+
+    async def close(self) -> None:
+        return None
+
+
+def _real_sets(n: int, tamper: int | None = None) -> list[SignatureSet]:
+    sks = interop_secret_keys(n)
+    out = []
+    for i, sk in enumerate(sks):
+        msg = bytes([i]) * 32
+        sig = bls.sign(sk, msg)
+        if i == tamper:
+            sig = bls.sign(sk, b"\xff" * 32)
+        out.append(SignatureSet(pubkey=sk.to_pubkey(), message=msg, signature=sig))
+    return out
+
+
+def _dummy_sets(n: int = 1) -> list[SignatureSet]:
+    return [
+        SignatureSet(pubkey=bytes([i + 1]) * 48, message=bytes([i]) * 32, signature=bytes([i]) * 96)
+        for i in range(n)
+    ]
+
+
+# -- layer semantics ----------------------------------------------------------
+
+
+def test_error_degrades_to_next_layer_and_false_is_final():
+    err = _ErroringVerifier()
+    strict = BlsVerifierMock(verdict=False)
+    lenient = BlsVerifierMock(verdict=True)
+    deg = DegradingBlsVerifier([("a", err), ("b", strict), ("c", lenient)])
+
+    async def go():
+        # a errs -> b serves False; c must NOT be consulted (no verdict
+        # shopping: an invalid answer is an answer)
+        assert await deg.verify_signature_sets(_dummy_sets()) is False
+        assert deg.last_layer == "b"
+        assert err.calls == 1 and strict.calls and not lenient.calls
+
+    asyncio.run(go())
+
+
+def test_not_accepting_layer_skipped_without_attempt():
+    err = _ErroringVerifier(accepting=False)
+    ok = BlsVerifierMock(verdict=True)
+    metrics = create_metrics().resilience
+    deg = DegradingBlsVerifier([("a", err), ("b", ok)], metrics=metrics)
+
+    async def go():
+        assert await deg.verify_signature_sets(_dummy_sets()) is True
+        assert err.calls == 0  # skipped, not attempted
+        assert deg.last_layer == "b"
+        assert metrics.fallback_skipped.labels("a")._value.get() == 1
+        assert metrics.fallback_verifications.labels("b")._value.get() == 1
+        assert metrics.fallback_active._value.get() == 1
+
+    asyncio.run(go())
+
+
+def test_all_layers_erring_fails_closed_with_last_error():
+    a, b = _ErroringVerifier(), _ErroringVerifier()
+    deg = DegradingBlsVerifier([("a", a), ("b", b)])
+
+    async def go():
+        with pytest.raises(RuntimeError, match="layer down"):
+            await deg.verify_signature_sets(_dummy_sets())
+
+    asyncio.run(go())
+    assert a.calls == 1 and b.calls == 1
+
+
+def test_can_accept_work_is_any_layer():
+    deg = DegradingBlsVerifier(
+        [("a", _ErroringVerifier(accepting=False)), ("b", BlsVerifierMock())]
+    )
+    assert deg.can_accept_work()
+    deg2 = DegradingBlsVerifier([("a", _ErroringVerifier(accepting=False))])
+    assert not deg2.can_accept_work()
+
+
+class _SaturatedButAlive(IBlsVerifier):
+    """is_down False (viable endpoints) + can_accept False (cap hit) —
+    the offload client's saturation shape."""
+
+    async def verify_signature_sets(self, sets, opts=None) -> bool:
+        raise AssertionError("saturated layer should not matter here")
+
+    def is_down(self) -> bool:
+        return False
+
+    def can_accept_work(self) -> bool:
+        return False
+
+    async def close(self) -> None:
+        return None
+
+
+def test_saturated_primary_still_governs_backpressure():
+    """Busy is not down: a saturated-but-alive primary's refusal must
+    reach the gossip processor (shed), NOT be silently bypassed by the
+    degrader onto the slower fallback layer."""
+    deg = DegradingBlsVerifier(
+        [("offload", _SaturatedButAlive()), ("cpu", BlsSingleThreadVerifier())]
+    )
+    assert not deg.can_accept_work()  # primary in rotation -> its verdict stands
+
+
+def test_layer_without_is_down_is_always_attempted():
+    """A verifier exposing only can_accept_work (the base interface) is
+    never inferred down from saturation — it is attempted, and its
+    errors degrade like any other."""
+    busy_no_is_down = BlsVerifierMock(verdict=True)
+    busy_no_is_down.can_accept_work = lambda: False
+    deg = DegradingBlsVerifier([("a", busy_no_is_down), ("b", BlsVerifierMock())])
+
+    async def go():
+        assert await deg.verify_signature_sets(_dummy_sets()) is True
+        assert deg.last_layer == "a"  # attempted despite can_accept False
+
+    asyncio.run(go())
+
+
+def test_degraded_layer_actually_reverifies_not_assumes():
+    """The chain's fail-closed core: after the primary errs, a fallback
+    layer runs the REAL verification — valid sets pass, tampered sets
+    fail, on the same degraded path."""
+    deg = DegradingBlsVerifier(
+        [("offload", _ErroringVerifier()), ("cpu", BlsSingleThreadVerifier())]
+    )
+
+    async def go():
+        assert await deg.verify_signature_sets(_real_sets(2)) is True
+        assert deg.last_layer == "cpu"
+        assert await deg.verify_signature_sets(_real_sets(2, tamper=1)) is False
+        assert deg.last_layer == "cpu"
+
+    asyncio.run(go())
+
+
+def test_wedged_device_pool_is_skipped_by_the_chain():
+    """Middle-layer wedge: a pool whose backend always explodes opens
+    its device breaker; the degrader then skips it without paying one
+    failed launch per call."""
+
+    def exploding(sets):
+        raise RuntimeError("device wedged")
+
+    async def go():
+        pool = BlsDeviceVerifierPool(exploding, scheduler_enabled=False)
+        deg = DegradingBlsVerifier([("device_pool", pool), ("cpu", BlsSingleThreadVerifier())])
+        # enough rejected jobs to cross the wedge threshold
+        for _ in range(DEVICE_WEDGE_THRESHOLD):
+            assert await deg.verify_signature_sets(_real_sets(1)) is True
+        assert not pool.can_accept_work()
+        # now served by cpu without touching the pool
+        before = pool.metrics["errors"]
+        assert await deg.verify_signature_sets(_real_sets(1)) is True
+        assert pool.metrics["errors"] == before
+        assert deg.last_layer == "cpu"
+        await deg.close()
+
+    asyncio.run(go())
+
+
+# -- acceptance: block import with offload fully partitioned ------------------
+
+
+def test_block_imports_through_degradation_chain_with_offload_partitioned(minimal_preset):
+    """All offload endpoints partitioned mid-run: a signed block still
+    imports via offload -> CPU degradation inside its slot deadline, and
+    a tampered block still rejects (fail-closed preserved end-to-end)."""
+    from lodestar_tpu.chain.chain import BeaconChain, BlockError
+    from lodestar_tpu.db import MemoryDbController
+    from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
+
+    from ..state_transition.test_state_transition import _empty_block_at
+
+    p = minimal_preset
+    N = 16
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+
+    server_a = BlsOffloadServer(verify_signature_sets, port=0)
+    server_b = BlsOffloadServer(verify_signature_sets, port=0)
+    server_a.start()
+    server_b.start()
+    inj = FaultInjector()
+    metrics = create_metrics()
+    client = BlsOffloadClient(
+        [f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"],
+        breaker_threshold=2,
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+        metrics=metrics.resilience,
+    )
+    deg = DegradingBlsVerifier(
+        [("offload", client), ("cpu", BlsSingleThreadVerifier())],
+        metrics=metrics.resilience,
+    )
+    try:
+        # sanity: with the network healthy the offload layer serves
+        chain = BeaconChain(
+            anchor_state=genesis, bls_verifier=deg, db=MemoryDbController(), current_slot=2
+        )
+        signed1 = _empty_block_at(genesis, 1, sks, p)
+
+        async def import_healthy():
+            await chain.process_block(signed1)
+
+        asyncio.run(import_healthy())
+        assert deg.last_layer == "offload"
+        state1 = chain.get_head_state()
+        assert state1.slot == 1
+
+        # partition EVERY endpoint and import the next block
+        inj.partition("*")
+        signed2 = _empty_block_at(state1, 2, sks, p)
+
+        async def import_partitioned():
+            t0 = time.monotonic()
+            await chain.process_block(signed2)
+            return time.monotonic() - t0
+
+        elapsed = asyncio.run(import_partitioned())
+        assert chain.get_head_state().slot == 2
+        assert deg.last_layer == "cpu"
+        # "within its slot deadline": breaker-fast failover + CPU verify,
+        # nowhere near the 6s minimal-preset slot
+        assert elapsed < 6.0
+        assert metrics.resilience.fallback_verifications.labels("cpu")._value.get() >= 1
+
+        # fail-closed survives degradation: tampered block rejects
+        bad = signed2.copy()
+        bad.signature = b"\xc0" + bytes(95)
+
+        async def import_bad():
+            chain2 = BeaconChain(
+                anchor_state=genesis, bls_verifier=deg, db=MemoryDbController(), current_slot=2
+            )
+            with pytest.raises(BlockError):
+                await chain2.process_block(bad)
+
+        asyncio.run(import_bad())
+    finally:
+        asyncio.run(deg.close())
+        server_a.stop()
+        server_b.stop()

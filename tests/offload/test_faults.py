@@ -1,0 +1,238 @@
+"""Fault-injection harness: seeded determinism, rule scheduling, the
+digest-checked verdict wire format, and the fast chaos invariant — no
+injected fault class ever turns an invalid set into a True verdict."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.offload import (
+    OffloadError,
+    VERDICT_FRAME_BYTES,
+    decode_verdict,
+    encode_sets,
+    encode_verdict,
+    verdict_digest,
+)
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.testing import FaultInjector, FaultKind, FaultRule
+
+
+def _sets(n: int = 1) -> list[SignatureSet]:
+    return [
+        SignatureSet(pubkey=bytes([i + 1]) * 48, message=bytes([i]) * 32, signature=bytes([i]) * 96)
+        for i in range(n)
+    ]
+
+
+# -- verdict wire format ------------------------------------------------------
+
+
+def test_digest_verdict_roundtrip():
+    req = encode_sets(_sets(2))
+    for ok in (True, False):
+        frame = encode_verdict(ok, request=req)
+        assert len(frame) == VERDICT_FRAME_BYTES
+        assert decode_verdict(frame, request=req) is ok
+        # also parses without the request (digest unchecked)
+        assert decode_verdict(frame) is ok
+    # legacy 1-byte frames still parse (old server)
+    assert decode_verdict(b"\x01") is True
+    assert decode_verdict(b"\x00") is False
+
+
+def test_digest_verdict_rejects_flip_splice_and_corruption():
+    req = encode_sets(_sets(2))
+    frame = encode_verdict(False, request=req)
+    # flipped verdict byte: digest no longer binds
+    with pytest.raises(OffloadError, match="digest mismatch"):
+        decode_verdict(bytes([1]) + frame[1:], request=req)
+    # reply spliced from a different request
+    other = encode_sets(_sets(3))
+    with pytest.raises(OffloadError, match="digest mismatch"):
+        decode_verdict(encode_verdict(False, request=other), request=req)
+    # digest covers the verdict byte
+    assert verdict_digest(req, 0) != verdict_digest(req, 1)
+    # strictness: trailing garbage / unknown lead bytes fail closed
+    with pytest.raises(OffloadError):
+        decode_verdict(b"\x01garbage")
+    with pytest.raises(OffloadError):
+        decode_verdict(b"\x07")
+    with pytest.raises(OffloadError):
+        decode_verdict(frame[:-1], request=req)  # truncated
+    with pytest.raises(OffloadError):
+        decode_verdict(b"")
+    # downgrade protection: once an endpoint has spoken the digest
+    # format, a bare legacy byte is a truncation, not compat
+    with pytest.raises(OffloadError, match="downgrade"):
+        decode_verdict(b"\x01", request=req, require_digest=True)
+    assert decode_verdict(frame, request=req, require_digest=True) is False
+
+
+# -- injector unit ------------------------------------------------------------
+
+
+def test_fault_rule_windows_and_filters():
+    r = FaultRule(
+        FaultKind.UNAVAILABLE,
+        first_call=2,
+        last_call=3,
+        targets=frozenset({"a"}),
+        methods=frozenset({"verify"}),
+    )
+    assert not r.matches("a", "verify", 1)
+    assert r.matches("a", "verify", 2) and r.matches("a", "verify", 3)
+    assert not r.matches("a", "verify", 4)
+    assert not r.matches("b", "verify", 2)
+    assert not r.matches("a", "status", 2)
+
+
+def test_injector_is_deterministic_from_seed():
+    rules = [FaultRule(FaultKind.UNAVAILABLE, probability=0.5)]
+
+    def decisions(seed):
+        inj = FaultInjector(rules, seed=seed)
+        return [inj._next_fault("t", "verify")[0] for _ in range(64)]
+
+    a, b = decisions(42), decisions(42)
+    assert a == b
+    assert decisions(43) != a  # and the seed matters
+    assert any(k is FaultKind.UNAVAILABLE for k in a)
+    assert any(k is None for k in a)
+
+
+def test_corruption_is_deterministic_from_seed():
+    data = encode_verdict(False, request=b"x" * 20)
+    a = FaultInjector(seed=7)._corrupt(data)
+    b = FaultInjector(seed=7)._corrupt(data)
+    assert a == b and a != data
+
+
+def test_partition_and_heal_runtime_toggle():
+    inj = FaultInjector()
+    assert inj._next_fault("a", "verify")[0] is None
+    inj.partition("a")
+    assert inj._next_fault("a", "verify")[0] is FaultKind.PARTITION
+    assert inj._next_fault("b", "verify")[0] is None
+    inj.partition("*")
+    assert inj._next_fault("b", "verify")[0] is FaultKind.PARTITION
+    inj.heal("*")
+    assert inj._next_fault("a", "verify")[0] is None
+
+
+def test_backend_seam_rejects_transport_only_kinds():
+    inj = FaultInjector(
+        [FaultRule(FaultKind.FLIP_VERDICT, methods=frozenset({"backend"}))]
+    )
+    with pytest.raises(ValueError, match="transport fault"):
+        inj.wrap_backend(lambda s: True)
+
+
+def test_backend_faults_latency_and_error():
+    inj = FaultInjector(
+        [
+            FaultRule(
+                FaultKind.ERROR_FRAME, methods=frozenset({"backend"}), first_call=0, last_call=0
+            )
+        ]
+    )
+    backend = inj.wrap_backend(lambda s: True)
+    with pytest.raises(RuntimeError, match="injected backend fault"):
+        backend(_sets())
+    assert backend(_sets()) is True  # window over
+
+
+# -- the fast chaos invariant -------------------------------------------------
+
+# one rule per fault class, each owning a disjoint call-index window so
+# every class provably fires (schedule-driven, no coin flips)
+_WINDOWED_FAULTS = [
+    FaultRule(FaultKind.LATENCY, delay_s=0.02, first_call=0, last_call=1, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.DEADLINE, first_call=2, last_call=3, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.UNAVAILABLE, first_call=4, last_call=5, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.RESET, first_call=6, last_call=7, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.ERROR_FRAME, first_call=8, last_call=9, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.CORRUPT_VERDICT, first_call=10, last_call=11, methods=frozenset({"verify"})),
+    FaultRule(FaultKind.FLIP_VERDICT, first_call=12, last_call=13, methods=frozenset({"verify"})),
+]
+
+
+def test_chaos_invariant_no_fault_yields_true_for_invalid_sets():
+    """Acceptance invariant (fast arm): the backend deems every set
+    invalid; across every injected fault class the client must return
+    False or raise — never True. FLIP_VERDICT is the sharp case: the
+    in-flight flip of a well-formed False frame must be caught by the
+    digest check, not decoded as True."""
+    server = BlsOffloadServer(lambda s: False, port=0)
+    server.start()
+    target = f"127.0.0.1:{server.port}"
+    inj = FaultInjector(_WINDOWED_FAULTS, seed=1234)
+    client = BlsOffloadClient(
+        target,
+        timeout_s=1.0,
+        breaker_threshold=100,  # soundness test: keep dialing through the storm
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+    )
+    outcomes = {"false": 0, "error": 0}
+    try:
+
+        async def go():
+            for _ in range(18):  # covers all windows + fault-free tail
+                try:
+                    verdict = await client.verify_signature_sets(_sets(2))
+                except Exception:  # fail closed: an error is an acceptable outcome
+                    outcomes["error"] += 1
+                    continue
+                assert verdict is False, "invalid sets must never verify True"
+                outcomes["false"] += 1
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+    # every fault class actually fired, and both outcome shapes occurred
+    for rule in _WINDOWED_FAULTS:
+        assert inj.injected[rule.kind] >= 1, f"{rule.kind} never fired"
+    assert outcomes["false"] >= 1 and outcomes["error"] >= 1
+
+
+def test_chaos_invariant_holds_through_server_backend_faults():
+    """Reply-path arm: the SERVER's backend misbehaves (exceptions →
+    error frames); the client must fail closed every time."""
+    inj = FaultInjector(
+        [
+            FaultRule(
+                FaultKind.ERROR_FRAME, methods=frozenset({"backend"}), probability=0.5
+            )
+        ],
+        seed=99,
+    )
+    server = BlsOffloadServer(inj.wrap_backend(lambda s: False), port=0)
+    server.start()
+    client = BlsOffloadClient(
+        f"127.0.0.1:{server.port}",
+        breaker_threshold=100,  # keep dialing through the error storm
+        probe_interval_s=3600.0,
+    )
+    try:
+
+        async def go():
+            for _ in range(16):
+                try:
+                    verdict = await client.verify_signature_sets(_sets())
+                except OffloadError:
+                    continue
+                assert verdict is False
+
+        asyncio.run(go())
+        assert inj.injected[FaultKind.ERROR_FRAME] >= 1
+    finally:
+        asyncio.run(client.close())
+        server.stop()

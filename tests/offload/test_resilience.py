@@ -1,0 +1,416 @@
+"""Circuit breaker + deadline budgets: state machine, breaker-aware
+routing (open endpoints skipped without dialing), class deadlines and
+the hedged retry to a second endpoint."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.metrics import create_metrics
+from lodestar_tpu.offload import OffloadError
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.resilience import (
+    CLASS_DEADLINE_S,
+    BreakerState,
+    CircuitBreaker,
+    deadline_for,
+)
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.testing import FaultInjector, FaultKind, FaultRule
+
+
+def _sets(n: int = 1) -> list[SignatureSet]:
+    """Opaque wire-shaped sets: these tests exercise transport/routing,
+    the backend is a stub verdict function."""
+    return [
+        SignatureSet(pubkey=bytes([i + 1]) * 48, message=bytes([i]) * 32, signature=bytes([i]) * 96)
+        for i in range(n)
+    ]
+
+
+# -- CircuitBreaker unit ------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("reset_timeout_s", 2.0)
+    kw.setdefault("max_reset_timeout_s", 8.0)
+    kw.setdefault("jitter", 0.0)
+    return CircuitBreaker(clock=clock, **kw)
+
+
+def test_breaker_opens_after_threshold_and_half_open_trial():
+    clock = _Clock()
+    transitions = []
+    b = _breaker(clock)
+    b._on_transition = lambda old, new: transitions.append((old, new))
+
+    assert b.state() is BreakerState.CLOSED and not b.is_open
+    for _ in range(2):
+        b.record_failure()
+    assert b.state() is BreakerState.CLOSED  # under threshold
+    b.record_failure()
+    assert b.state() is BreakerState.OPEN and b.is_open
+    assert transitions == [(BreakerState.CLOSED, BreakerState.OPEN)]
+
+    # open refuses admission until the reset delay elapses
+    assert not b.try_acquire()
+    clock.t += 2.0
+    assert not b.is_open  # delay elapsed: a trial is available
+    assert b.try_acquire()  # half-open, one trial admitted
+    assert b.state() is BreakerState.HALF_OPEN
+    assert not b.try_acquire()  # the trial slot is held
+    b.record_success()
+    assert b.state() is BreakerState.CLOSED
+    assert transitions[-1] == (BreakerState.HALF_OPEN, BreakerState.CLOSED)
+
+
+def test_breaker_reopen_doubles_reset_delay_with_cap():
+    clock = _Clock()
+    b = _breaker(clock)
+    for _ in range(3):
+        b.record_failure()
+    assert b.seconds_until_trial() == pytest.approx(2.0)
+
+    # failed trial -> re-open with doubled delay
+    clock.t += 2.0
+    assert b.try_acquire()
+    b.record_failure()
+    assert b.state() is BreakerState.OPEN
+    assert b.seconds_until_trial() == pytest.approx(4.0)
+
+    # another failed trial doubles again, then the cap holds
+    clock.t += 4.0
+    assert b.try_acquire()
+    b.record_failure()
+    assert b.seconds_until_trial() == pytest.approx(8.0)
+    clock.t += 8.0
+    assert b.try_acquire()
+    b.record_failure()
+    assert b.seconds_until_trial() == pytest.approx(8.0)  # capped
+
+    # success from half-open resets the streak
+    clock.t += 8.0
+    assert b.try_acquire()
+    b.record_success()
+    for _ in range(3):
+        b.record_failure()
+    assert b.seconds_until_trial() == pytest.approx(2.0)
+
+
+def test_breaker_failure_while_open_past_delay_rearms():
+    """Callers that gate on is_open alone (the pool's wedge check never
+    calls try_acquire) let work through once the reset delay elapses; a
+    failure there must re-arm the window with the escalated delay, or
+    the breaker stops gating forever after its first reset."""
+    clock = _Clock()
+    b = _breaker(clock)
+    for _ in range(3):
+        b.record_failure()
+    clock.t += 2.0
+    assert not b.is_open  # delay elapsed: is_open-only callers admit work
+    b.record_failure()  # ...and it fails again
+    assert b.is_open  # re-armed
+    assert b.seconds_until_trial() == pytest.approx(4.0)  # escalated
+    clock.t += 4.0
+    b.record_failure()
+    assert b.seconds_until_trial() == pytest.approx(8.0)
+
+
+def test_breaker_probe_success_releases_open_wait():
+    clock = _Clock()
+    b = _breaker(clock)
+    for _ in range(3):
+        b.record_failure()
+    assert not b.try_acquire()
+    b.note_probe_success()  # out-of-band recovery evidence
+    assert b.try_acquire()  # trial granted without waiting out the delay
+    assert b.state() is BreakerState.HALF_OPEN
+
+
+def test_breaker_success_resets_consecutive_failures():
+    clock = _Clock()
+    b = _breaker(clock)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state() is BreakerState.CLOSED  # not consecutive
+
+
+# -- deadline budgets ---------------------------------------------------------
+
+
+def test_class_deadlines_and_cap():
+    assert deadline_for(PriorityClass.GOSSIP_BLOCK) == CLASS_DEADLINE_S[PriorityClass.GOSSIP_BLOCK]
+    assert deadline_for(PriorityClass.BACKFILL) == 30.0
+    # gossip block budget is tight, bulk generous
+    assert (
+        deadline_for(PriorityClass.GOSSIP_BLOCK) < deadline_for(PriorityClass.API)
+        < deadline_for(PriorityClass.RANGE_SYNC)
+    )
+    # a caller-configured flat timeout stays an upper bound
+    assert deadline_for(PriorityClass.BACKFILL, cap=1.0) == 1.0
+    assert deadline_for(PriorityClass.GOSSIP_BLOCK, cap=30.0) == 2.0
+
+
+# -- client integration -------------------------------------------------------
+
+
+@pytest.fixture()
+def two_servers():
+    a = BlsOffloadServer(lambda s: True, port=0)
+    b = BlsOffloadServer(lambda s: True, port=0)
+    a.start()
+    b.start()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def _gossip_block_opts() -> VerifySignatureOpts:
+    return VerifySignatureOpts(priority=int(PriorityClass.GOSSIP_BLOCK))
+
+
+def test_breaker_open_endpoint_skipped_without_probe_thread(two_servers):
+    """The acceptance invariant: after the breaker opens, the hot path
+    routes around the endpoint IMMEDIATELY — no dial, no deadline wait,
+    no dependence on the probe thread (probe interval is 1h here).
+
+    The fault is a GRAY failure (server answers error frames, transport
+    fine): probe health stays True, so the breaker — not the old binary
+    health bit — is provably what stops the dialing."""
+    a, b = two_servers
+    A, B = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    inj = FaultInjector(
+        [FaultRule(FaultKind.ERROR_FRAME, targets=frozenset({A}), methods=frozenset({"verify"}))]
+    )
+    metrics = create_metrics().resilience
+    client = BlsOffloadClient(
+        [A, B],
+        breaker_threshold=2,
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+        metrics=metrics,
+    )
+    try:
+        # let the one startup probe land first — a probe success AFTER
+        # the breaker opens would legitimately re-admit a trial
+        # (note_probe_success) and change the dial count
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not all(
+            s["extended"] for s in client.endpoint_states()
+        ):
+            time.sleep(0.01)
+
+        async def go():
+            # each call: A fails (hedge to B wins) until A's breaker opens
+            for _ in range(4):
+                assert await client.verify_signature_sets(_sets(), _gossip_block_opts()) is True
+
+        asyncio.run(go())
+        dialed_a = inj.calls_to(A, "verify")
+        assert dialed_a == 2  # opened at the threshold, never dialed again
+        states = {s["target"]: s for s in client.endpoint_states()}
+        assert states[A]["breaker"] == "open"
+        assert states[A]["healthy"]  # gray failure: health alone wouldn't skip
+        assert states[B]["breaker"] == "closed"
+        # routed/hedge/failover counters exported per endpoint
+        assert metrics.routed.labels(B)._value.get() >= 2
+        assert metrics.failovers.labels(A)._value.get() == 2
+        assert metrics.hedges.labels("gossip_block")._value.get() == 2
+        assert metrics.hedge_wins.labels("gossip_block")._value.get() == 2
+        assert metrics.breaker_state.labels(A)._value.get() == int(BreakerState.OPEN)
+    finally:
+        asyncio.run(client.close())
+
+
+def test_all_breakers_open_fails_fast_and_sheds(two_servers):
+    a, b = two_servers
+    A, B = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    # status faulted too: a late initial probe succeeding would release
+    # the open breakers (note_probe_success) and re-admit a trial
+    inj = FaultInjector([FaultRule(FaultKind.UNAVAILABLE)])
+    client = BlsOffloadClient(
+        [A, B],
+        breaker_threshold=1,
+        breaker_reset_s=60.0,
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+    )
+    try:
+
+        async def go():
+            # threshold=1: each failing call opens one endpoint's breaker
+            # (no hedge once probes mark endpoints unhealthy)
+            for _ in range(2):
+                with pytest.raises(OffloadError):
+                    await client.verify_signature_sets(_sets(), _gossip_block_opts())
+            assert all(s["breaker"] == "open" for s in client.endpoint_states())
+            dialed = inj.calls_to(A, "verify") + inj.calls_to(B, "verify")
+            # both breakers open now: the next call must not dial at all
+            t0 = time.monotonic()
+            with pytest.raises(OffloadError, match="breakers open"):
+                await client.verify_signature_sets(_sets(), _gossip_block_opts())
+            assert time.monotonic() - t0 < 0.5  # no deadline wait
+            assert inj.calls_to(A, "verify") + inj.calls_to(B, "verify") == dialed
+            # admission reflects it: the gossip processor would shed,
+            # and the degradation chain treats the layer as down
+            assert not client.can_accept_work()
+            assert client.is_down()
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())
+
+
+def test_latency_past_class_deadline_hedges_to_second_endpoint(two_servers):
+    """A slow endpoint blows the tight gossip-block budget; the hedged
+    retry lands on the healthy peer well inside a slot."""
+    a, b = two_servers
+    A, B = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    inj = FaultInjector(
+        [
+            FaultRule(
+                FaultKind.LATENCY,
+                delay_s=5.0,
+                targets=frozenset({A}),
+                methods=frozenset({"verify"}),
+            )
+        ]
+    )
+    client = BlsOffloadClient(
+        [A, B],
+        probe_interval_s=3600.0,
+        class_deadlines={PriorityClass.GOSSIP_BLOCK: 0.3},
+        transport_wrapper=inj.wrap_transport,
+    )
+    try:
+
+        async def go():
+            t0 = time.monotonic()
+            assert await client.verify_signature_sets(_sets(), _gossip_block_opts()) is True
+            elapsed = time.monotonic() - t0
+            assert elapsed < 2.0  # one 0.3s deadline + the fast hedge
+            assert inj.calls_to(B, "verify") == 1
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())
+
+
+def test_bulk_class_does_not_hedge(two_servers):
+    a, b = two_servers
+    A, B = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    inj = FaultInjector(
+        [FaultRule(FaultKind.UNAVAILABLE, targets=frozenset({A}), methods=frozenset({"verify"}))]
+    )
+    client = BlsOffloadClient(
+        [A, B], probe_interval_s=3600.0, transport_wrapper=inj.wrap_transport
+    )
+    try:
+
+        async def go():
+            opts = VerifySignatureOpts(priority=int(PriorityClass.BACKFILL))
+            with pytest.raises(OffloadError):
+                await client.verify_signature_sets(_sets(), opts)
+            assert inj.calls_to(B, "verify") == 0  # no hedge for bulk
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())
+
+
+def test_recovered_endpoint_readopted_while_sibling_stays_closed(two_servers):
+    """A briefly-sick endpoint must not stay circuit-open forever just
+    because a healthy sibling absorbs all traffic: once its reset delay
+    elapses, a first-attempt request is spent as the half-open trial and
+    success re-closes the breaker."""
+    a, b = two_servers
+    A, B = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    # gray failure on A for exactly two calls, then recovered
+    inj = FaultInjector(
+        [
+            FaultRule(
+                FaultKind.ERROR_FRAME,
+                targets=frozenset({A}),
+                methods=frozenset({"verify"}),
+                first_call=0,
+                last_call=1,
+            )
+        ]
+    )
+    client = BlsOffloadClient(
+        [A, B],
+        breaker_threshold=2,
+        breaker_reset_s=0.05,
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+    )
+    try:
+
+        async def go():
+            for _ in range(2):  # open A's breaker (hedges keep verdicts True)
+                assert await client.verify_signature_sets(_sets(), _gossip_block_opts())
+            states = {s["target"]: s["breaker"] for s in client.endpoint_states()}
+            assert states[A] == "open"
+            time.sleep(0.1)  # A's reset delay elapses; B stays closed
+            assert await client.verify_signature_sets(_sets(), _gossip_block_opts())
+            states = {s["target"]: s["breaker"] for s in client.endpoint_states()}
+            assert states[A] == "closed"  # trial went to A and re-closed it
+            assert inj.calls_to(A, "verify") == 3
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())
+
+
+def test_half_open_trial_recloses_breaker_after_recovery(two_servers):
+    a, b = two_servers
+    A = f"127.0.0.1:{a.port}"
+    inj = FaultInjector(
+        [
+            FaultRule(
+                FaultKind.UNAVAILABLE,
+                methods=frozenset({"verify"}),
+                first_call=0,
+                last_call=1,
+            )
+        ]
+    )
+    client = BlsOffloadClient(
+        A,
+        breaker_threshold=2,
+        breaker_reset_s=0.05,
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+    )
+    try:
+
+        async def go():
+            for _ in range(2):
+                with pytest.raises(OffloadError):
+                    await client.verify_signature_sets(_sets())
+            assert client.endpoint_states()[0]["breaker"] == "open"
+            time.sleep(0.1)  # reset delay elapses; fault window is over
+            assert await client.verify_signature_sets(_sets()) is True
+            assert client.endpoint_states()[0]["breaker"] == "closed"
+
+        asyncio.run(go())
+    finally:
+        asyncio.run(client.close())

@@ -1,0 +1,100 @@
+"""utils.retry / retry_sync backoff mode + the backoff_delay helper the
+offload circuit breaker's half-open schedule uses."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.utils import backoff_delay, retry, retry_sync
+
+
+def test_backoff_delay_exponential_with_cap():
+    assert backoff_delay(0, base=0.5) == 0.5
+    assert backoff_delay(1, base=0.5) == 1.0
+    assert backoff_delay(3, base=0.5) == 4.0
+    assert backoff_delay(10, base=0.5, max_delay=8.0) == 8.0
+    assert backoff_delay(2, base=1.0, factor=3.0) == 9.0
+    with pytest.raises(ValueError):
+        backoff_delay(-1, base=0.5)
+
+
+def test_backoff_delay_jitter_stays_under_cap():
+    # jitter subtracts (spreads the fleet) — max_delay is a TRUE upper
+    # bound even at saturation
+    lo = backoff_delay(2, base=1.0, max_delay=3.0, jitter=0.5, rng=lambda: 1.0)
+    hi = backoff_delay(2, base=1.0, max_delay=3.0, jitter=0.5, rng=lambda: 0.0)
+    assert lo == pytest.approx(1.5) and hi == 3.0
+    for _ in range(32):
+        d = backoff_delay(2, base=1.0, max_delay=3.0, jitter=0.5)
+        assert 1.5 <= d <= 3.0
+
+
+def test_retry_sync_backoff_progression(monkeypatch):
+    sleeps: list[float] = []
+    monkeypatch.setattr("lodestar_tpu.utils.time.sleep", sleeps.append)
+    calls = [0]
+
+    def failing():
+        calls[0] += 1
+        raise RuntimeError("nope")
+
+    with pytest.raises(RuntimeError):
+        retry_sync(
+            failing, retries=4, retry_delay=0.1, backoff_factor=2.0, max_delay=0.3
+        )
+    assert calls[0] == 4
+    assert sleeps == pytest.approx([0.1, 0.2, 0.3])  # capped at max_delay
+
+
+def test_retry_sync_fixed_delay_unchanged(monkeypatch):
+    """No backoff_factor -> the existing fixed-delay contract."""
+    sleeps: list[float] = []
+    monkeypatch.setattr("lodestar_tpu.utils.time.sleep", sleeps.append)
+    with pytest.raises(RuntimeError):
+        retry_sync(_raise, retries=3, retry_delay=0.2)
+    assert sleeps == [0.2, 0.2]
+
+
+def _raise():
+    raise RuntimeError("nope")
+
+
+def test_async_retry_backoff_progression(monkeypatch):
+    sleeps: list[float] = []
+
+    async def fake_sleep(d):
+        sleeps.append(d)
+
+    monkeypatch.setattr("lodestar_tpu.utils.asyncio.sleep", fake_sleep)
+
+    async def failing():
+        raise RuntimeError("nope")
+
+    async def go():
+        with pytest.raises(RuntimeError):
+            await retry(failing, retries=3, retry_delay=0.5, backoff_factor=2.0)
+
+    asyncio.run(go())
+    assert sleeps == pytest.approx([0.5, 1.0])
+
+
+def test_async_retry_succeeds_mid_backoff(monkeypatch):
+    async def fake_sleep(d):
+        pass
+
+    monkeypatch.setattr("lodestar_tpu.utils.asyncio.sleep", fake_sleep)
+    attempts = [0]
+
+    async def flaky():
+        attempts[0] += 1
+        if attempts[0] < 3:
+            raise RuntimeError("not yet")
+        return "ok"
+
+    async def go():
+        return await retry(flaky, retries=5, retry_delay=0.1, backoff_factor=2.0)
+
+    assert asyncio.run(go()) == "ok"
+    assert attempts[0] == 3

@@ -279,6 +279,7 @@ def all_dashboards():
         ("lodestar_db.json", db_dashboard()),
         ("lodestar_block_pipeline_trace.json", trace_dashboard()),
         ("lodestar_sched_occupancy.json", sched_dashboard()),
+        ("lodestar_offload_resilience.json", resilience_dashboard()),
     )
 
 
@@ -546,6 +547,69 @@ def sched_dashboard():
         "Lodestar TPU - Device work scheduler",
         ps,
         ["lodestar", "scheduler"],
+    )
+
+
+def resilience_dashboard():
+    """Offload resilience (offload/resilience.py + chain/bls/fallback.py):
+    per-endpoint routing/failover/hedge rates, circuit-breaker states,
+    and the degradation chain's fallback activity. The "is the offload
+    leg healthy, and what is absorbing its failures" dashboard."""
+    ps = [
+        panel(
+            "Breaker state by endpoint (0 closed / 1 half-open / 2 open)",
+            [("lodestar_resilience_breaker_state", "{{endpoint}}")],
+            pid=1,
+        ),
+        panel(
+            "Verify RPCs routed by endpoint",
+            [
+                ("sum by (endpoint) (rate(lodestar_resilience_routed_total[5m]))", "{{endpoint}}"),
+            ],
+            unit="ops", x=12, pid=2,
+        ),
+        panel(
+            "Failovers / breaker transitions",
+            [
+                ("sum by (endpoint) (rate(lodestar_resilience_failover_total[5m]))", "failover {{endpoint}}"),
+                (
+                    "sum by (endpoint, state) (rate(lodestar_resilience_breaker_transitions_total[5m]))",
+                    "{{endpoint}} -> {{state}}",
+                ),
+            ],
+            unit="ops", y=8, pid=3,
+        ),
+        panel(
+            "Hedged retries by class",
+            [
+                ("sum by (class) (rate(lodestar_resilience_hedge_total[5m]))", "hedged {{class}}"),
+                ("sum by (class) (rate(lodestar_resilience_hedge_win_total[5m]))", "won {{class}}"),
+            ],
+            unit="ops", x=12, y=8, pid=4,
+        ),
+        panel(
+            "Degradation chain activity",
+            [
+                ("lodestar_resilience_fallback_active", "fallback active"),
+                ("sum by (layer) (rate(lodestar_resilience_fallback_total[5m]))", "served {{layer}}"),
+                (
+                    "sum by (layer) (rate(lodestar_resilience_fallback_skipped_total[5m]))",
+                    "skipped {{layer}}",
+                ),
+            ],
+            y=16, pid=5,
+        ),
+        panel(
+            "Admission sheds by reason",
+            [("sum by (reason) (rate(lodestar_resilience_shed_total[5m]))", "{{reason}}")],
+            unit="ops", x=12, y=16, pid=6,
+        ),
+    ]
+    return dashboard(
+        "lodestar-offload-resilience",
+        "Lodestar TPU - Offload resilience",
+        ps,
+        ["lodestar", "resilience"],
     )
 
 
