@@ -21,11 +21,22 @@ busy: a saturated-but-alive layer is still attempted and still governs
 silently funneling every verify onto a slower fallback layer.
 
 Every downgrade records a `bls_fallback` trace span and a
-`lodestar_resilience_fallback_*` metric; `last_layer` names the layer
-that served the most recent verdict (surfaced into the block import
-trace)."""
+`lodestar_resilience_fallback_*` metric. The layer that served a
+verdict is reported per-CALL through a contextvar (`serving_layer()`),
+so two imports interleaving at the event loop each stamp THEIR OWN
+`verifier_layer` on the `bls_verify` span — `last_layer` (one shared
+slot, kept for dashboards/tests that want "most recent") is explicitly
+not call-accurate under concurrency.
+
+`in_outage()` reports the all-layers-erred terminal state (telemetry /
+notifier signal; any layer serving a verdict clears it). Peer-scoring
+does NOT read this shared flag — the chain stamps `verifier_outage`
+on the rejection exception itself, so classification is per-call and
+cannot race a concurrently recovering import."""
 
 from __future__ import annotations
+
+from contextvars import ContextVar
 
 from lodestar_tpu import tracing
 from lodestar_tpu.crypto.bls.api import SignatureSet
@@ -34,6 +45,12 @@ from lodestar_tpu.logger import get_logger
 from .interface import IBlsVerifier, VerifySignatureOpts
 
 __all__ = ["DegradingBlsVerifier"]
+
+#: the layer that served the CURRENT task's most recent verdict. A
+#: contextvar, not an attribute: concurrent imports run in separate
+#: asyncio tasks (separate contexts), so each caller reads the layer
+#: that served ITS verdict, never a sibling's.
+_serving_layer: ContextVar[str | None] = ContextVar("bls_serving_layer", default=None)
 
 
 class DegradingBlsVerifier(IBlsVerifier):
@@ -44,6 +61,7 @@ class DegradingBlsVerifier(IBlsVerifier):
             raise ValueError("at least one verifier layer required")
         self.layers = list(layers)
         self.last_layer: str | None = None
+        self._outage = False
         self._metrics = metrics
         self._log = get_logger(name="lodestar.bls-degrade")
 
@@ -68,6 +86,8 @@ class DegradingBlsVerifier(IBlsVerifier):
                 )
                 continue
             self.last_layer = name
+            _serving_layer.set(name)
+            self._outage = False  # some layer answers: not an outage
             if self._metrics is not None:
                 self._metrics.fallback_active.set(0 if name == primary else 1)
                 if name != primary:
@@ -75,10 +95,27 @@ class DegradingBlsVerifier(IBlsVerifier):
                     # also errs must not show up as having served verdicts
                     self._metrics.fallback_verifications.labels(name).inc()
             return verdict
-        # every layer erred or refused: fail closed with the last error
+        # every layer erred or refused: fail closed with the last error.
+        # This IS the verifier outage. The flag is advisory telemetry
+        # only — scoring reads the per-rejection `verifier_outage` mark
+        # the chain stamps on the exception, never this shared slot.
+        self._outage = True
         if last_err is not None:
             raise last_err
         raise RuntimeError("no bls verifier layer accepts work")
+
+    def serving_layer(self) -> str | None:
+        """The layer that served THIS task's most recent verdict
+        (call-accurate under concurrent imports, unlike `last_layer`)."""
+        return _serving_layer.get()
+
+    def in_outage(self) -> bool:
+        """True after a verify had every layer err/refuse, until any
+        layer serves again. Advisory (dashboards, notifier, tests): a
+        shared slot, so concurrent imports can flip it — scoring
+        decisions ride the rejection exception instead (chain.py sets
+        `verifier_outage` per call)."""
+        return self._outage
 
     def _note_skip(self, name: str) -> None:
         if self._metrics is not None:

@@ -54,9 +54,29 @@ class BlockErrorCode:
 
 
 class BlockError(Exception):
+    #: set True on rejections produced while the BLS verifier stack was
+    #: in outage (every degradation layer erred): the gossip processor
+    #: must NOT downscore the sending peer for a local incident
+    verifier_outage: bool = False
+
     def __init__(self, code: str, message: str = ""):
         super().__init__(f"{code}: {message}" if message else code)
         self.code = code
+
+    @property
+    def action(self):
+        """Gossip scoring action (mirrors GossipValidationError.action):
+        provably-invalid content REJECTs — and downscores the sender —
+        while availability/ordering codes (parent unknown, future slot,
+        already known) carry no peer evidence."""
+        if self.code in (
+            BlockErrorCode.INVALID_SIGNATURES,
+            BlockErrorCode.INVALID_STATE_TRANSITION,
+        ):
+            from .validation import GossipAction
+
+            return GossipAction.REJECT
+        return None
 
 
 def _hex(b: bytes) -> str:
@@ -415,8 +435,14 @@ class BeaconChain:
                 )
                 if sp:
                     # DegradingBlsVerifier names the layer that actually
-                    # served — a slow-slot dump shows degraded imports
-                    layer = getattr(self.bls, "last_layer", None)
+                    # served — a slow-slot dump shows degraded imports.
+                    # serving_layer() is a contextvar read: this TASK's
+                    # verdict, not whichever import finished last
+                    serving = getattr(self.bls, "serving_layer", None)
+                    layer = (
+                        serving() if callable(serving)
+                        else getattr(self.bls, "last_layer", None)
+                    )
                     if layer is not None:
                         sp.set(verifier_layer=layer)
                 return ok
@@ -448,8 +474,18 @@ class BeaconChain:
             raise stf_res
         if isinstance(sig_res, BaseException):
             # fail closed: a verifier/transport error rejects the block
-            # import, it never resolves valid (multithread/index.ts:386-393)
-            raise BlockError(BlockErrorCode.INVALID_SIGNATURES, f"verifier error: {sig_res!r}")
+            # import, it never resolves valid (multithread/index.ts:386-393).
+            # A verifier ERROR is never evidence about the block (only a
+            # served False verdict is): the rejection is local fail-closed
+            # policy, so it is ALWAYS marked as a verifier fault and gossip
+            # scoring spares the honest sender (network/processor.py). This
+            # is per-rejection state riding the exception itself — no
+            # shared flag to race against a concurrently recovering import.
+            err = BlockError(
+                BlockErrorCode.INVALID_SIGNATURES, f"verifier error: {sig_res!r}"
+            )
+            err.verifier_outage = True
+            raise err
         post_state, sigs_ok = stf_res, sig_res
         if not sigs_ok:
             raise BlockError(BlockErrorCode.INVALID_SIGNATURES, _hex(block_root))

@@ -77,6 +77,42 @@ def _add_scheduler_args(sp) -> None:
         "oracle, device = local device pool then CPU, none = fail closed with "
         "no fallback (blocks reject while the offload host is down)",
     )
+    from lodestar_tpu.offload.audit import DEFAULT_AUDIT_BUDGET, DEFAULT_AUDIT_RATE
+    from lodestar_tpu.offload.resilience import DEFAULT_QUARANTINE_COOLOFF_S
+
+    sp.add_argument(
+        "--offload-audit-rate", type=float, default=DEFAULT_AUDIT_RATE,
+        help="base probability an offload verdict is re-verified against an "
+        "independent verifier (gossip classes at full rate, bulk classes "
+        "scaled down; 0 disables Byzantine auditing)",
+    )
+    sp.add_argument(
+        "--offload-audit-budget", type=float, default=DEFAULT_AUDIT_BUDGET,
+        help="fraction of one CPU core the audit worker may consume (duty-cycle "
+        "cap; excess samples are dropped, never queued against the hot path)",
+    )
+    sp.add_argument(
+        "--offload-audit-via", choices=["cpu", "helper"], default="cpu",
+        help="independent verifier for audits: cpu = the in-process oracle, "
+        "helper = a second offload endpoint with CPU arbitration on "
+        "disagreement (needs >= 2 endpoints, else falls back to cpu)",
+    )
+    sp.add_argument(
+        "--offload-audit-seed", type=int, default=None,
+        help="seed for the audit sampler — testing/replay ONLY (a helper that "
+        "can predict the sample stream can lie on unsampled verdicts; the "
+        "default draws an unpredictable seed and logs it)",
+    )
+    sp.add_argument(
+        "--offload-quarantine-sec", type=float, default=DEFAULT_QUARANTINE_COOLOFF_S,
+        help="cool-off before a quarantined (caught-lying) endpoint gets one "
+        "half-open trial; 0 = quarantined until --offload-unquarantine",
+    )
+    sp.add_argument(
+        "--offload-unquarantine", action="append", default=[], metavar="HOST:PORT",
+        help="admin action: lift a persisted Byzantine quarantine for this "
+        "endpoint at startup (repeatable)",
+    )
 
 
 def _build_parser(with_subparsers: bool = False):
@@ -279,6 +315,12 @@ async def _run_dev(args) -> int:
             offload_breaker_threshold=args.offload_breaker_threshold,
             offload_breaker_reset_s=args.offload_breaker_reset_sec,
             offload_fallback=args.offload_fallback,
+            offload_audit_rate=args.offload_audit_rate,
+            offload_audit_budget=args.offload_audit_budget,
+            offload_audit_via=args.offload_audit_via,
+            offload_audit_seed=args.offload_audit_seed,
+            offload_quarantine_cooloff_s=args.offload_quarantine_sec,
+            offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
         ),
         p=p,
@@ -435,6 +477,12 @@ async def _run_beacon(args) -> int:
             offload_breaker_threshold=args.offload_breaker_threshold,
             offload_breaker_reset_s=args.offload_breaker_reset_sec,
             offload_fallback=args.offload_fallback,
+            offload_audit_rate=args.offload_audit_rate,
+            offload_audit_budget=args.offload_audit_budget,
+            offload_audit_via=args.offload_audit_via,
+            offload_audit_seed=args.offload_audit_seed,
+            offload_quarantine_cooloff_s=args.offload_quarantine_sec,
+            offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
         ),
         p=p,

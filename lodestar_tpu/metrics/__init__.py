@@ -31,6 +31,7 @@ __all__ = [
     "TraceMetrics",
     "SchedulerMetrics",
     "ResilienceMetrics",
+    "AuditMetrics",
     "create_metrics",
     "MetricsServer",
     "ValidatorMonitor",
@@ -292,6 +293,24 @@ class ResilienceMetrics:
     fallback_verifications: Counter  # degraded verifications served, by layer
     fallback_skipped: Counter  # layers skipped (not accepting), by layer
     fallback_active: Gauge  # 1 while a non-primary layer served last
+    outage_unscored: Counter  # outage-caused rejections spared from peer scoring
+
+
+@dataclass
+class AuditMetrics:
+    """lodestar_offload_audit_* — the Byzantine audit subsystem
+    (`offload/audit.py`): sampled/re-verified verdict counts, audit CPU
+    spend against its budget, per-endpoint trust EWMA, Byzantine events
+    and quarantine states."""
+
+    sampled: Counter  # verdicts picked for re-verification, by launch class
+    verified: Counter  # completed re-verifications, by outcome agree/disagree
+    dropped: Counter  # sampled-but-not-audited, by reason (queue_full/queue_bytes/audit_error)
+    byzantine: Counter  # Byzantine events (re-check contradicted), by endpoint
+    trust_score: Gauge  # audit trust EWMA per endpoint (1.0 = never contradicted)
+    quarantined: Gauge  # 1 while the endpoint is quarantined
+    queue_depth: Gauge  # audit queue backlog
+    cpu_seconds: Counter  # audit re-verification CPU time (budget accounting)
 
 
 @dataclass
@@ -330,6 +349,7 @@ class BeaconMetrics:
     trace: "TraceMetrics"
     sched: "SchedulerMetrics"
     resilience: "ResilienceMetrics"
+    audit: "AuditMetrics"
     head_slot: Gauge
     finalized_epoch: Gauge
     justified_epoch: Gauge
@@ -716,6 +736,49 @@ def create_metrics() -> BeaconMetrics:
             "lodestar_resilience_fallback_active",
             "1 while the most recent verification was served by a non-primary layer",
         ),
+        outage_unscored=c.counter(
+            "lodestar_resilience_outage_unscored_total",
+            "Gossip rejections caused by a local verifier outage, spared from peer downscoring",
+        ),
+    )
+    audit = AuditMetrics(
+        sampled=c.counter(
+            "lodestar_offload_audit_sampled_total",
+            "Offload verdicts sampled for independent re-verification, by class",
+            ["class"],
+        ),
+        verified=c.counter(
+            "lodestar_offload_audit_verified_total",
+            "Completed audit re-verifications by outcome (agree/disagree)",
+            ["outcome"],
+        ),
+        dropped=c.counter(
+            "lodestar_offload_audit_dropped_total",
+            "Sampled verdicts not audited (queue_full/queue_bytes/audit_error)",
+            ["reason"],
+        ),
+        byzantine=c.counter(
+            "lodestar_offload_audit_byzantine_total",
+            "Byzantine events: helper verdicts contradicted by re-verification",
+            ["endpoint"],
+        ),
+        trust_score=c.gauge(
+            "lodestar_offload_audit_trust_score",
+            "Per-endpoint audit trust EWMA (1.0 = never contradicted)",
+            ["endpoint"],
+        ),
+        quarantined=c.gauge(
+            "lodestar_offload_audit_quarantined",
+            "1 while the endpoint is quarantined for a Byzantine event",
+            ["endpoint"],
+        ),
+        queue_depth=c.gauge(
+            "lodestar_offload_audit_queue_depth", "Audit re-verification backlog"
+        ),
+        cpu_seconds=c.counter(
+            "lodestar_offload_audit_cpu_seconds_total",
+            "CPU time spent re-verifying sampled verdicts (budget accounting)",
+        ),
     )
     sched = SchedulerMetrics(
         queue_depth=c.gauge(
@@ -766,6 +829,7 @@ def create_metrics() -> BeaconMetrics:
         trace=trace,
         sched=sched,
         resilience=resilience,
+        audit=audit,
         head_slot=c.gauge("beacon_head_slot", "Current head slot"),
         finalized_epoch=c.gauge("beacon_finalized_epoch", "Finalized epoch"),
         justified_epoch=c.gauge("beacon_current_justified_epoch", "Justified epoch"),

@@ -180,12 +180,30 @@ class NetworkProcessor:
                         "gossip handler error", {"topic": topic, "error": str(e)[:120]}
                     )
                     # REJECT-class failures downscore the sender
-                    # (reference gossipHandlers -> peerManager scoring)
+                    # (reference gossipHandlers -> peerManager scoring) —
+                    # UNLESS the rejection was produced by a local
+                    # verifier outage (degradation chain exhausted): a
+                    # valid block rejected because OUR verifier stack is
+                    # down says nothing about the peer, and downscoring
+                    # during an operator-side incident would shed honest
+                    # peers exactly when the node is most fragile
                     if self.report_peer is not None and item.peer:
                         from lodestar_tpu.chain.validation import GossipAction
 
                         if getattr(e, "action", None) is GossipAction.REJECT:
-                            self.report_peer(item.peer, f"{topic}: {e}")
+                            if getattr(e, "verifier_outage", False):
+                                resilience = getattr(self.metrics, "resilience", None)
+                                if resilience is not None:
+                                    # dedicated counter: these are COMPLETED
+                                    # rejections, not deferred/shed work —
+                                    # they must not inflate the shed panels
+                                    resilience.outage_unscored.inc()
+                                self.log.warn(
+                                    "rejection during verifier outage: peer not downscored",
+                                    {"topic": topic, "peer": item.peer},
+                                )
+                            else:
+                                self.report_peer(item.peer, f"{topic}: {e}")
                 submitted += 1
                 progressed = True
                 break  # re-evaluate backpressure + priorities each job

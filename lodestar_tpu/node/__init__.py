@@ -46,6 +46,12 @@ class BeaconNodeOptions:
         offload_breaker_threshold: int | None = None,
         offload_breaker_reset_s: float | None = None,
         offload_fallback: str = "cpu",
+        offload_audit_rate: float | None = None,
+        offload_audit_budget: float | None = None,
+        offload_audit_via: str = "cpu",
+        offload_audit_seed: int | None = None,
+        offload_quarantine_cooloff_s: float | None = None,
+        offload_unquarantine: list[str] | None = None,
         scheduler_enabled: bool = True,
     ):
         self.db_path = db_path
@@ -93,6 +99,31 @@ class BeaconNodeOptions:
         if offload_fallback not in ("none", "cpu", "device"):
             raise ValueError(f"offload_fallback must be none|cpu|device, got {offload_fallback!r}")
         self.offload_fallback = offload_fallback
+        # Byzantine audit (offload/audit.py): randomized cross-checking
+        # of offload verdicts against an independent verifier. rate 0
+        # disables; "helper" re-verifies on a second endpoint (CPU
+        # arbitration) when more than one is configured.
+        from lodestar_tpu.offload.audit import DEFAULT_AUDIT_BUDGET, DEFAULT_AUDIT_RATE
+        from lodestar_tpu.offload.resilience import DEFAULT_QUARANTINE_COOLOFF_S
+
+        self.offload_audit_rate = (
+            DEFAULT_AUDIT_RATE if offload_audit_rate is None else offload_audit_rate
+        )
+        self.offload_audit_budget = (
+            DEFAULT_AUDIT_BUDGET if offload_audit_budget is None else offload_audit_budget
+        )
+        if offload_audit_via not in ("cpu", "helper"):
+            raise ValueError(f"offload_audit_via must be cpu|helper, got {offload_audit_via!r}")
+        self.offload_audit_via = offload_audit_via
+        self.offload_audit_seed = offload_audit_seed
+        # quarantine cool-off after a Byzantine event; 0 = until the
+        # operator lifts it (--offload-unquarantine)
+        self.offload_quarantine_cooloff_s = (
+            DEFAULT_QUARANTINE_COOLOFF_S
+            if offload_quarantine_cooloff_s is None
+            else offload_quarantine_cooloff_s
+        )
+        self.offload_unquarantine = list(offload_unquarantine or [])
         # device work scheduler (lodestar_tpu.scheduler) for the in-process
         # pool; False restores FIFO launches (debug/comparison only)
         self.scheduler_enabled = scheduler_enabled
@@ -200,12 +231,80 @@ class BeaconNode:
         if opts.offload_endpoints:
             from lodestar_tpu.offload.client import BlsOffloadClient
 
+            # 3a. Byzantine audit: seeded sampler + background
+            # re-verification. Forensics + quarantine persistence:
+            # prefer the tracing export dir (next to the slow-slot
+            # dumps), else a subdirectory of the data dir — only a
+            # fully in-memory node runs without persistence
+            audit_dir = opts.tracing_export_dir
+            if audit_dir is None and opts.db_path:
+                import os as _os
+
+                # db_path is the WAL *file* (cli passes <dir>/wal.log):
+                # persist beside it, inside the data directory
+                audit_dir = _os.path.join(
+                    _os.path.dirname(_os.path.abspath(opts.db_path)), "offload-audit"
+                )
+            from lodestar_tpu.offload.audit import AuditSampler, OffloadAuditor
+
+            # ALWAYS constructed: with --offload-audit-rate 0 it is
+            # passive (no sampling thread) but still owns quarantine
+            # persistence, gauges and rehabilitation — a standing
+            # Byzantine verdict keeps its lifecycle regardless of the
+            # sampling knob
+            auditor = OffloadAuditor(
+                sampler=AuditSampler(
+                    opts.offload_audit_rate, seed=opts.offload_audit_seed
+                ),
+                budget=opts.offload_audit_budget,
+                dump_dir=audit_dir,
+                quarantine_cooloff_s=opts.offload_quarantine_cooloff_s or None,
+                metrics=metrics.audit,
+                start=opts.offload_audit_rate > 0,
+            )
             client = BlsOffloadClient(
                 opts.offload_endpoints,
                 breaker_threshold=opts.offload_breaker_threshold,
                 breaker_reset_s=opts.offload_breaker_reset_s,
                 metrics=metrics.resilience,
+                auditor=auditor,
+                quarantine_cooloff_s=opts.offload_quarantine_cooloff_s or None,
             )
+            if opts.offload_audit_via == "helper" and len(opts.offload_endpoints) > 1:
+                from lodestar_tpu.offload.audit import cross_helper_reference
+
+                auditor.set_reference(cross_helper_reference(client))
+            # operator lifts first, then re-apply persisted Byzantine
+            # quarantines — a restart must not silently re-trust a caught
+            # liar, and that holds even at --offload-audit-rate 0 (the
+            # passive auditor still reads/writes the quarantine file)
+            persisted_before = set(auditor.load_quarantined())
+            for target in opts.offload_unquarantine:
+                if target not in opts.offload_endpoints and target not in persisted_before:
+                    # a typo'd lift silently no-opping would leave the
+                    # operator believing the quarantine was cleared
+                    client.log.warn(
+                        "--offload-unquarantine target matches no configured "
+                        "endpoint and no persisted quarantine record",
+                        {"target": target},
+                    )
+                    continue
+                # clears breaker state AND (via the bound auditor) the
+                # persisted record — the lift logic lives in one place
+                client.unquarantine_endpoint(target)
+            import time as _time
+
+            from lodestar_tpu.offload.audit import remaining_cooloff
+
+            cool = opts.offload_quarantine_cooloff_s or None
+            now = _time.time()
+            for target, rec in auditor.load_quarantined().items():
+                if target in opts.offload_endpoints:
+                    client.quarantine_endpoint(
+                        target,
+                        cooloff_s=remaining_cooloff(rec, cool, now),
+                        reason="persisted_byzantine",
+                    )
             if opts.offload_fallback == "none":
                 bls = client
             else:

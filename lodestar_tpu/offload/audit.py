@@ -1,0 +1,824 @@
+"""Byzantine offload auditing: randomized cross-verification, helper
+trust scoring, and quarantine.
+
+The digest-checked verdict frame (PR 3) defeats a CORRUPTED reply, but
+not a helper that lies and signs its lie: a compromised accelerator
+host can return `True` for an invalid signature set, recompute the
+digest over its false verdict, and the node imports the block. 2G2T
+(PAPERS.md) shows the fix doesn't require re-verifying everything —
+statistically sound outsourcing needs only a small random sample
+re-checked against a trusted verifier: a helper that lies on fraction f
+of its verdicts survives n audited verdicts with probability (1-rf)^n,
+so at audit rate r the expected detection horizon is 1/(rf) samples and
+the 99th-percentile horizon is ln(0.01)/ln(1-rf).
+
+Three pieces, all OFF the hot path:
+
+* `AuditSampler` — seeded per-class sampling. Gossip classes (the ones
+  whose forged verdict imports a block within its slot) are sampled
+  aggressively; bulk classes (range sync / backfill — re-validated
+  against finalized checkpoints anyway) lightly. One seeded RNG drawn
+  in verdict-stream order makes chaos-soak audit runs replay exactly.
+
+* `TrustScore` — per-endpoint EWMA over agree/disagree audit outcomes.
+  Routing prefers trusted endpoints; the score is also the operator's
+  dashboard view of how much each helper has been contradicted.
+
+* `OffloadAuditor` — a bounded background queue drained by its own
+  thread. Sampled verdicts are re-verified against an INDEPENDENT
+  verifier — the CPU oracle by default, or a second helper endpoint
+  (with CPU arbitration on disagreement, so a lying REFERENCE is
+  caught too). A local re-check that contradicts the helper's verdict
+  is a **Byzantine event**: the endpoint is quarantined immediately
+  (forced breaker-open; survives half-open probes until the cool-off
+  or `--offload-unquarantine`), a forensics dump (request digest, both
+  verdicts, signature-set metadata, trace context) is written next to
+  the slow-slot dumps, and the quarantine is persisted so a restarted
+  node does not silently re-trust a caught liar. Audit CPU time is
+  duty-cycle capped (`budget`): a re-verification costing t of THREAD
+  CPU buys t*(1-b)/b of enforced idle (RPC wait in a cross-helper
+  reference spends no core and is not charged), so under saturation
+  auditing consumes at most fraction b of one core and sheds (drops
+  samples, counted) past its bounded queue instead of stealing import
+  throughput.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from lodestar_tpu.logger import get_logger
+from lodestar_tpu.scheduler import PriorityClass
+
+from . import decode_sets
+from .resilience import DEFAULT_QUARANTINE_COOLOFF_S
+
+__all__ = [
+    "AuditSampler",
+    "TrustScore",
+    "OffloadAuditor",
+    "AuditRecord",
+    "AUDIT_CLASS_WEIGHTS",
+    "DEFAULT_AUDIT_RATE",
+    "DEFAULT_AUDIT_BUDGET",
+    "TRUST_ROUTE_THRESHOLD",
+    "cpu_oracle_reference",
+    "cross_helper_reference",
+    "detection_horizon",
+    "load_quarantine_file",
+    "clear_quarantine_file",
+]
+
+#: base sampling rate (gossip-block verdicts audited per verdict served)
+DEFAULT_AUDIT_RATE = 0.05
+
+#: per-class multipliers on the base rate. Gossip classes carry the slot
+#: deadline (a forged verdict imports a block NOW) — full rate; API
+#: submissions near-full; bulk classes are cheap to lie about but their
+#: blocks are re-anchored by finalized checkpoints, so a light sample
+#: only bounds long-con drift.
+AUDIT_CLASS_WEIGHTS: dict[PriorityClass, float] = {
+    PriorityClass.GOSSIP_BLOCK: 1.0,
+    PriorityClass.GOSSIP_ATTESTATION: 1.0,
+    PriorityClass.API: 0.5,
+    PriorityClass.RANGE_SYNC: 0.1,
+    PriorityClass.BACKFILL: 0.05,
+}
+
+#: fraction of one core the audit worker may consume (duty-cycle cap)
+DEFAULT_AUDIT_BUDGET = 0.10
+
+#: sampled verdicts held for re-verification; beyond this, samples drop
+#: (counted) — bounded memory beats unbounded audit debt
+DEFAULT_AUDIT_QUEUE_MAX = 256
+
+#: byte cap on queued request frames: 256 records bounds count, but each
+#: record retains its full encoded frame, and bulk/range-sync frames run
+#: tens-to-hundreds of KB — under a slow reference at a tight budget the
+#: backlog could otherwise pin tens of MB invisible to the record-count
+#: queue_depth gauge
+DEFAULT_AUDIT_QUEUE_MAX_BYTES = 8 * 1024 * 1024
+
+#: routing demotes endpoints whose trust EWMA fell below this — they
+#: serve only when no trusted endpoint is viable
+TRUST_ROUTE_THRESHOLD = 0.5
+
+_QUARANTINE_FILE = "quarantine.json"
+
+
+def load_quarantine_file(dump_dir: str | None) -> dict[str, dict]:
+    """Read persisted Byzantine quarantines (target -> evidence) from
+    `dump_dir`. Module-level so the node can re-apply them at startup
+    even when auditing itself is disabled (--offload-audit-rate 0): a
+    caught liar stays quarantined regardless of the sampling knob.
+
+    A file that exists but does not parse is LOUD, not {}: silently
+    mapping corruption to "nothing quarantined" would re-trust a caught
+    liar after a crash (writes are atomic-rename, so this only happens
+    under outside interference or filesystem damage)."""
+    return _load_quarantine_entries(dump_dir)[0]
+
+
+def _load_quarantine_entries(dump_dir: str | None) -> tuple[dict[str, dict], bool]:
+    """(entries, damaged): `damaged` means the file EXISTS but could not
+    be read as a JSON object — callers that rewrite the file must
+    preserve the damaged original (it is the operator's evidence and may
+    hold recoverable quarantine records)."""
+    if not dump_dir:
+        return {}, False
+    path = os.path.join(dump_dir, _QUARANTINE_FILE)
+    if not os.path.exists(path):
+        return {}, False
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"expected a JSON object, got {type(data).__name__}"
+            )
+        return data, False
+    except (OSError, ValueError) as e:
+        get_logger(name="lodestar.offload.audit").error(
+            "quarantine file unreadable: persisted Byzantine verdicts "
+            "CANNOT be re-applied — inspect/restore it before trusting "
+            "offload helpers",
+            {"path": path, "error": str(e)[:120]},
+        )
+        return {}, True
+
+
+def _write_quarantine_file(dump_dir: str, entries: dict[str, dict]) -> None:
+    """Atomic (write-temp + rename): a crash mid-write must leave either
+    the old file or the new one, never a truncated record of who is
+    quarantined."""
+    os.makedirs(dump_dir, exist_ok=True)
+    path = os.path.join(dump_dir, _QUARANTINE_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(entries, f, indent=2)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def clear_quarantine_file(dump_dir: str | None, target: str) -> None:
+    """Drop one persisted quarantine (the --offload-unquarantine admin
+    action, usable with auditing disabled)."""
+    if not dump_dir:
+        return
+    entries = load_quarantine_file(dump_dir)
+    if target in entries:
+        del entries[target]
+        _write_quarantine_file(dump_dir, entries)
+
+
+def remaining_cooloff(entry: dict, cooloff_s: float | None, now: float) -> float | None:
+    """Cool-off left when re-applying a persisted quarantine at startup.
+
+    The record's `at` timestamp counts time already served: a node that
+    restarts faster than the configured cool-off must not re-arm a full
+    one every boot (the endpoint could never reach its half-open
+    rehabilitation trial). None = indefinite passes through; an elapsed
+    cool-off returns a minimal POSITIVE remainder — 0 would mean
+    indefinite to the breaker — so the endpoint is immediately
+    trial-eligible but still re-earns CLOSED."""
+    if cooloff_s is None:
+        return None
+    return max(0.001, float(entry.get("at", now)) + cooloff_s - now)
+
+
+def detection_horizon(rate: float, p: float = 0.01) -> int:
+    """Verdicts a lying-on-every-verdict helper survives with
+    probability p at audit rate `rate` — the invariant-test bound:
+    ⌈ln(p)/ln(1-rate)⌉."""
+    import math
+
+    if not 0.0 < rate < 1.0:
+        return 1
+    return math.ceil(math.log(p) / math.log(1.0 - rate))
+
+
+class AuditSampler:
+    """Seeded per-class Bernoulli sampling in verdict-stream order.
+
+    One `random.Random(seed)` drawn once per observed verdict (whatever
+    its class), so the pick sequence is a pure function of (seed,
+    verdict stream) — a chaos soak replays its audit decisions exactly.
+    Under concurrent submitters the stream order is the arrival order
+    at the lock, as with the fault injector's coin draws."""
+
+    def __init__(
+        self,
+        rate: float = DEFAULT_AUDIT_RATE,
+        *,
+        seed: int | None = None,
+        class_weights: dict[PriorityClass, float] | None = None,
+    ) -> None:
+        import random
+
+        self.base_rate = max(0.0, min(1.0, rate))
+        weights = class_weights or AUDIT_CLASS_WEIGHTS
+        self.rates = {
+            cls: min(1.0, self.base_rate * weights.get(cls, 1.0)) for cls in PriorityClass
+        }
+        # SECURITY: the adversary is the helper, and the helper sees the
+        # whole verdict stream — with a predictable seed it could replay
+        # the RNG and lie only on unsampled verdicts, zeroing the
+        # (1-rf)^n detection bound. Default to an unpredictable seed;
+        # an explicit seed is for tests/replay only (the chosen value is
+        # kept on self.seed so a failing run can still be replayed).
+        if seed is None:
+            seed = int.from_bytes(os.urandom(8), "little")
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def rate_for(self, priority: PriorityClass) -> float:
+        return self.rates.get(priority, self.base_rate)
+
+    def sample(self, priority: PriorityClass) -> bool:
+        """One draw per verdict — ALWAYS drawn, even at rate 0, so the
+        pick sequence for every class is invariant under another
+        class's rate change (determinism across configs that share the
+        stream)."""
+        with self._lock:
+            draw = self._rng.random()
+        return draw < self.rate_for(priority)
+
+
+class TrustScore:
+    """EWMA of audit agreement, 1.0 = never contradicted. A disagree
+    with alpha=0.25 drops the score to 0.75 of its mass immediately —
+    trust is slow to earn (many agrees) and fast to lose, which is the
+    right asymmetry for an adversary that lies rarely on purpose."""
+
+    __slots__ = ("score", "alpha", "agrees", "disagrees")
+
+    def __init__(self, alpha: float = 0.25, initial: float = 1.0) -> None:
+        self.alpha = alpha
+        self.score = initial
+        self.agrees = 0
+        self.disagrees = 0
+
+    def record(self, agree: bool) -> float:
+        if agree:
+            self.agrees += 1
+        else:
+            self.disagrees += 1
+        self.score = (1.0 - self.alpha) * self.score + (self.alpha if agree else 0.0)
+        return self.score
+
+
+@dataclass
+class AuditRecord:
+    """One sampled verdict awaiting re-verification. Holds the EXACT
+    request frame the helper answered (re-verification must bind to
+    what was asked, not a re-encoding of what we think was asked)."""
+
+    target: str
+    frame: bytes
+    n_sets: int
+    verdict: bool
+    priority: PriorityClass
+    trace_ctx: str | None
+    index: int  # position in the sampled stream (forensics/tests)
+
+
+def cpu_oracle_reference(sets, exclude_target: str):
+    """Default independent verifier: the in-process CPU oracle
+    (`crypto/bls/api.verify_signature_sets` — the documented ground
+    truth). Returns (verdict, None): None source = trusted, no
+    arbitration needed."""
+    from lodestar_tpu.crypto.bls.api import verify_signature_sets
+
+    return verify_signature_sets(sets), None
+
+
+def cross_helper_reference(client, *, timeout_s: float = 10.0):
+    """Re-verify against a SECOND helper endpoint of `client` (2G2T's
+    two-good-servers assumption): cheaper than the CPU oracle when the
+    sets are large, and the audited endpoint never checks its own
+    homework. Returns (verdict, source_target); falls back to the CPU
+    oracle (source None) when no sibling is viable. A disagreement
+    between two helpers is arbitrated by the auditor's CPU oracle, so
+    a lying REFERENCE endpoint is caught symmetrically."""
+    from . import decode_verdict, encode_sets
+
+    def reference(sets, exclude_target: str):
+        frame = encode_sets(list(sets))
+        with client._lock:
+            siblings = [
+                ep
+                for ep in client._endpoints
+                if ep.target != exclude_target and ep.healthy and not ep.breaker.is_open
+            ]
+        last_err: Exception | None = None
+        for ep in siblings:
+            # charge ep.outstanding like any in-flight RPC: the probe
+            # loop refuses to tear down a channel with work in flight,
+            # and an audit RPC is work in flight
+            with client._lock:
+                ep.outstanding += 1
+            try:
+                resp = ep.verify(frame, timeout=timeout_s)
+                return (
+                    decode_verdict(resp, request=frame, require_digest=ep.digest_seen),
+                    ep.target,
+                )
+            except Exception as e:
+                # audit traffic must not charge the breaker; try the
+                # next sibling
+                last_err = e
+                continue
+            finally:
+                with client._lock:
+                    ep.outstanding -= 1
+        # visible degradation: the operator configured helper-mode
+        # auditing — silently re-verifying on the oracle forever would
+        # misrepresent what is actually checking the helpers
+        client.log.warn(
+            "cross-helper audit fell back to the CPU oracle",
+            {
+                "audited": exclude_target,
+                "siblings_tried": len(siblings),
+                "error": str(last_err)[:120] if siblings else "no viable sibling",
+            },
+        )
+        return cpu_oracle_reference(sets, exclude_target)
+
+    return reference
+
+
+class OffloadAuditor:
+    """Randomized cross-verification of offload verdicts, off-hot-path.
+
+    `observe()` is the only hot-path touchpoint: one seeded coin flip
+    and (when sampled) a non-blocking bounded-queue put — no
+    re-verification, no I/O, no RPC ever runs on the caller's thread.
+    The audit worker drains the queue on its own thread under the CPU
+    duty-cycle budget."""
+
+    def __init__(
+        self,
+        *,
+        sampler: AuditSampler | None = None,
+        reference=None,
+        arbiter=None,
+        budget: float = DEFAULT_AUDIT_BUDGET,
+        queue_max: int = DEFAULT_AUDIT_QUEUE_MAX,
+        queue_max_bytes: int = DEFAULT_AUDIT_QUEUE_MAX_BYTES,
+        dump_dir: str | None = None,
+        quarantine_cooloff_s: float | None = DEFAULT_QUARANTINE_COOLOFF_S,
+        metrics=None,
+        start: bool = True,
+    ) -> None:
+        self.sampler = sampler or AuditSampler()
+        # reference(sets, exclude_target) -> (verdict, source_target|None)
+        self._reference = reference or cpu_oracle_reference
+        # arbiter(sets) -> bool: ground truth when two helpers disagree;
+        # default CPU oracle
+        self._arbiter = arbiter or (
+            lambda sets: cpu_oracle_reference(sets, "")[0]
+        )
+        self.budget = max(0.001, min(1.0, budget))
+        self.dump_dir = dump_dir
+        self.quarantine_cooloff_s = quarantine_cooloff_s
+        self._metrics = metrics  # AuditMetrics (metrics/__init__.py) or stub
+        self._queue: queue.Queue[AuditRecord] = queue.Queue(maxsize=queue_max)
+        self._queue_max_bytes = max(1, queue_max_bytes)
+        self._queue_bytes = 0  # retained frame bytes, guarded by _lock
+        self._lock = threading.Lock()
+        self.trust: dict[str, TrustScore] = {}
+        self.log = get_logger(name="lodestar.offload.audit")
+        # quarantine_cb(target, cooloff_s, reason) — bound by the client
+        self._quarantine_cb = None
+        self._closed = False
+        self.sampled = 0
+        self.audited = 0
+        self.dropped = 0
+        self._processed = 0  # records fully handled by the worker (drain())
+        # persisted-quarantine targets (lazy cache over quarantine.json):
+        # lets note_rehabilitated() be a set-lookup no-op per probe tick
+        self._persisted_targets: set[str] | None = None
+        self._fs_lock = threading.Lock()  # quarantine.json read-modify-write
+        self._stop = threading.Event()  # close() interrupts budget idle waits
+        # recent events only (ring): the dump files are the durable
+        # forensics — a flaky-Byzantine helper cycling quarantine→rehab
+        # must not leak memory in a list nothing in production reads
+        self.byzantine_events: deque[dict] = deque(maxlen=64)
+        self.audit_thread_names: set[str] = set()
+        self._dump_seq = 0
+        self._thread = threading.Thread(
+            target=self._drain_loop, name="offload-audit", daemon=True
+        )
+        if dump_dir is None:
+            # quarantine still works in-memory, but a restart re-trusts
+            # a caught liar and no forensics survive — say so up front
+            self.log.warn(
+                "offload audit has no dump dir: Byzantine forensics and "
+                "quarantine persistence are disabled for this process"
+            )
+        # the seed is logged (not secret from the OPERATOR — only from
+        # the helper) so a detected incident can be replayed exactly
+        self.log.info(
+            "offload audit up",
+            {
+                "seed": self.sampler.seed,
+                "base_rate": self.sampler.base_rate,
+                "budget": self.budget,
+            },
+        )
+        # start=False builds a PASSIVE auditor: no worker thread and
+        # observe() is a no-op — but quarantine persistence, gauges and
+        # rehabilitation cleanup all still work. The node uses this for
+        # --offload-audit-rate 0, where the standing quarantine verdicts
+        # must keep their full lifecycle even though sampling is off.
+        self._started = start
+        if start:
+            self._thread.start()
+
+    # -- wiring ----------------------------------------------------------------
+
+    def bind(self, quarantine_cb) -> None:
+        """`BlsOffloadClient` registers its quarantine hook here; the
+        auditor never imports the client (no cycle)."""
+        self._quarantine_cb = quarantine_cb
+
+    def set_reference(self, reference) -> None:
+        """Swap the independent verifier after construction — the
+        cross-helper reference needs the client, and the client takes
+        the auditor, so second-helper auditing wires up in two steps."""
+        self._reference = reference
+
+    def trust_for(self, target: str) -> TrustScore:
+        with self._lock:
+            ts = self.trust.get(target)
+            if ts is None:
+                ts = self.trust[target] = TrustScore()
+            return ts
+
+    def trust_value(self, target: str) -> float:
+        """Routing read: current EWMA (1.0 for never-audited)."""
+        with self._lock:
+            ts = self.trust.get(target)
+            return ts.score if ts is not None else 1.0
+
+    def note_quarantine(self, target: str, active: bool) -> None:
+        """Gauge bookkeeping for quarantine flips (the client calls this
+        from quarantine_endpoint/unquarantine_endpoint)."""
+        if self._metrics is not None:
+            self._metrics.quarantined.labels(target).set(1 if active else 0)
+
+    # -- hot-path touchpoint ---------------------------------------------------
+
+    def observe(
+        self,
+        target: str,
+        frame: bytes,
+        n_sets: int,
+        verdict: bool,
+        priority: PriorityClass,
+        trace_ctx: str | None = None,
+    ) -> bool:
+        """Called by the client with every offload-served verdict. One
+        coin flip; sampled verdicts enqueue (never block). Returns
+        whether the verdict was sampled (tests).
+
+        False verdicts are ALWAYS audited, independent of the sampler:
+        a False immediately rejects a block and downscores its sender,
+        so a helper lying False about valid blocks would shed honest
+        peers ~1/rate times before a rate-limited audit caught it.
+        Honest False verdicts are rare (invalid gossip is the
+        exception), so full coverage is nearly free — and a Byzantine
+        helper spamming False to burn audit CPU just gets itself
+        quarantined on the first re-check. The sampler draw still
+        happens first, so the pick stream for True verdicts is
+        unchanged (seeded replays stay exact)."""
+        if self._closed or not self._started:
+            return False
+        if not self.sampler.sample(priority) and verdict is not False:
+            return False
+        with self._lock:
+            idx = self.sampled
+            self.sampled += 1
+        m = self._metrics
+        if m is not None:
+            m.sampled.labels(priority.label).inc()
+        rec = AuditRecord(
+            target=target,
+            frame=frame,
+            n_sets=n_sets,
+            verdict=verdict,
+            priority=priority,
+            trace_ctx=trace_ctx,
+            index=idx,
+        )
+        # byte cap first: big bulk frames can pin MBs behind a slow
+        # reference long before 256 records fill — reserve the bytes
+        # under the lock, release them if the record-count put loses
+        with self._lock:
+            if self._queue_bytes + len(frame) > self._queue_max_bytes:
+                self.dropped += 1
+                if m is not None:
+                    m.dropped.labels("queue_bytes").inc()
+                return False
+            self._queue_bytes += len(frame)
+        try:
+            self._queue.put_nowait(rec)
+        except queue.Full:
+            # saturated: shedding audit coverage is the budget contract —
+            # the hot path never waits on the audit backlog
+            with self._lock:
+                self.dropped += 1
+                self._queue_bytes -= len(frame)
+            if m is not None:
+                m.dropped.labels("queue_full").inc()
+            return False
+        if m is not None:
+            m.queue_depth.set(self._queue.qsize())
+        return True
+
+    # -- background drain ------------------------------------------------------
+
+    def _drain_loop(self) -> None:
+        while not self._closed:
+            try:
+                rec = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self._lock:
+                self._queue_bytes -= len(rec.frame)
+            # the budget is a CPU cap: charge this thread's CPU time, not
+            # wall time — a cross-helper reference blocked on a slow RPC
+            # spends no core and must not buy forced idleness (that would
+            # starve the auditor and silently stretch the detection bound)
+            t0 = time.thread_time()
+            try:
+                self._audit_one(rec)
+            except Exception as e:  # an audit error must never kill the thread
+                self.log.warn(
+                    "audit re-verification error",
+                    {"target": rec.target, "error": str(e)[:120]},
+                )
+                if self._metrics is not None:
+                    self._metrics.dropped.labels("audit_error").inc()
+            finally:
+                # counted on COMPLETION (success or error): drain() is
+                # processed+dropped==sampled, which unlike a busy flag
+                # has no pop-to-flag scheduling window to race
+                with self._lock:
+                    self._processed += 1
+            dt = time.thread_time() - t0
+            m = self._metrics
+            if m is not None:
+                m.cpu_seconds.inc(dt)
+                m.queue_depth.set(self._queue.qsize())
+            # duty-cycle cap: b of one core — t busy buys t*(1-b)/b idle.
+            # Event-wait, not sleep: a big bulk frame at a tight budget
+            # can owe tens of seconds of idle, and close() must not wait
+            # out that debt behind an uninterruptible sleep
+            if self.budget < 1.0 and dt > 0 and not self._closed:
+                self._stop.wait(dt * (1.0 - self.budget) / self.budget)
+
+    def _audit_one(self, rec: AuditRecord) -> None:
+        self.audit_thread_names.add(threading.current_thread().name)
+        sets = decode_sets(rec.frame)
+        ref_verdict, ref_source = self._reference(sets, rec.target)
+        with self._lock:
+            self.audited += 1
+        m = self._metrics
+        if ref_verdict == rec.verdict:
+            self.trust_for(rec.target).record(True)
+            if ref_source is not None:
+                self.trust_for(ref_source).record(True)
+            if m is not None:
+                m.verified.labels("agree").inc()
+                self._export_trust(rec.target, ref_source)
+            return
+        # disagreement. When the reference was another HELPER, arbitrate
+        # with the oracle — exactly one of the two contradicts ground
+        # truth, and THAT one is the liar (2G2T: one good server
+        # suffices to catch the other).
+        if ref_source is not None:
+            truth = self._arbiter(sets)
+            liar = rec.target if truth != rec.verdict else ref_source
+            honest = ref_source if liar == rec.target else rec.target
+            self.trust_for(honest).record(True)
+        else:
+            truth = ref_verdict
+            liar = rec.target
+            honest = None
+        self.trust_for(liar).record(False)
+        if m is not None:
+            m.verified.labels("disagree").inc()
+            m.byzantine.labels(liar).inc()
+            self._export_trust(rec.target, ref_source)
+        self._byzantine_event(rec, sets, liar, ref_verdict, ref_source, truth)
+
+    def _export_trust(self, *targets: str | None) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        for t in targets:
+            if t:
+                m.trust_score.labels(t).set(self.trust_value(t))
+
+    # -- Byzantine events ------------------------------------------------------
+
+    def _byzantine_event(
+        self,
+        rec: AuditRecord,
+        sets,
+        liar: str,
+        ref_verdict: bool,
+        ref_source: str | None,
+        truth: bool,
+    ) -> None:
+        event = {
+            "kind": "byzantine_offload_verdict",
+            "endpoint": liar,
+            "audited_endpoint": rec.target,
+            "request_digest": hashlib.sha256(rec.frame).hexdigest(),
+            "claimed_verdict": rec.verdict,
+            "recheck_verdict": ref_verdict,
+            "recheck_source": ref_source or "cpu_oracle",
+            "arbiter_verdict": truth,
+            "class": rec.priority.label,
+            "n_sets": rec.n_sets,
+            "signature_sets": _set_metadata(sets),
+            "trace_ctx": rec.trace_ctx,
+            "sampled_index": rec.index,
+            "trust_score": self.trust_value(liar),
+            "quarantine_cooloff_s": self.quarantine_cooloff_s,
+            "wall_time": time.time(),
+        }
+        self.byzantine_events.append(event)
+        self.log.error(
+            "BYZANTINE offload helper: verdict contradicted by re-verification; quarantining",
+            {k: event[k] for k in ("endpoint", "claimed_verdict", "recheck_verdict", "class")},
+        )
+        dump_path = self._write_dump(event)
+        if dump_path is not None:
+            event["dump_path"] = dump_path
+        self._persist_quarantine(liar, event["request_digest"])
+        if self._quarantine_cb is not None:
+            try:
+                self._quarantine_cb(liar, self.quarantine_cooloff_s, "byzantine_audit")
+            except Exception as e:
+                self.log.error("quarantine callback failed", {"error": str(e)[:120]})
+
+    def _write_dump(self, event: dict) -> str | None:
+        """Forensics next to the slow-slot dumps (the tracing export
+        dir): the full evidence an operator needs to take one helper
+        host to the incident channel."""
+        if not self.dump_dir:
+            return None
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with self._lock:
+                seq = self._dump_seq
+                self._dump_seq += 1
+            name = f"byzantine_{_sanitize(event['endpoint'])}_{seq}.json"
+            path = os.path.join(self.dump_dir, name)
+            with open(path, "w") as f:
+                json.dump(event, f, indent=2)
+                f.write("\n")
+            return path
+        except OSError as e:
+            self.log.warn("byzantine forensics dump failed", {"error": str(e)[:120]})
+            return None
+
+    # -- quarantine persistence ------------------------------------------------
+    # All quarantine.json access goes through the module-level helpers
+    # under self._fs_lock: the audit thread persists a NEW record while
+    # the probe thread may be clearing a rehabilitated one — an unlocked
+    # read-modify-write could drop the fresh record on the floor.
+
+    def _persist_quarantine(self, target: str, request_digest: str) -> None:
+        if self.dump_dir is None:
+            return
+        with self._fs_lock:
+            try:
+                entries, damaged = _load_quarantine_entries(self.dump_dir)
+                if damaged:
+                    # the file the operator was told to inspect/restore
+                    # must not be clobbered by the fresh record — it may
+                    # hold recoverable quarantines; move it aside first
+                    path = os.path.join(self.dump_dir, _QUARANTINE_FILE)
+                    saved = f"{path}.damaged-{int(time.time())}"
+                    os.replace(path, saved)
+                    self.log.error(
+                        "damaged quarantine file moved aside before "
+                        "persisting a new Byzantine record — recover any "
+                        "prior quarantines from it",
+                        {"saved": saved},
+                    )
+                entries[target] = {"at": time.time(), "request_digest": request_digest}
+                _write_quarantine_file(self.dump_dir, entries)
+                self._persisted_targets = set(entries)
+            except OSError as e:
+                self.log.warn("quarantine persist failed", {"error": str(e)[:120]})
+
+    def load_quarantined(self) -> dict[str, dict]:
+        """Persisted Byzantine verdicts (target -> evidence). A restart
+        must not silently re-trust a caught liar, so the node re-applies
+        these at startup unless the operator passed
+        --offload-unquarantine for the target."""
+        with self._fs_lock:
+            entries = load_quarantine_file(self.dump_dir)
+            self._persisted_targets = set(entries)
+            return entries
+
+    def clear_quarantine(self, target: str) -> None:
+        if self.dump_dir is None:
+            return
+        with self._fs_lock:
+            entries = load_quarantine_file(self.dump_dir)
+            if target not in entries:
+                self._persisted_targets = set(entries)
+                return
+            del entries[target]
+            try:
+                _write_quarantine_file(self.dump_dir, entries)
+                self._persisted_targets = set(entries)
+            except OSError as e:
+                # a failed clear means the NEXT restart re-quarantines —
+                # the operator's lift must not be reverted silently
+                self.log.error(
+                    "quarantine clear failed: the persisted record will "
+                    "re-apply on restart",
+                    {"target": target, "error": str(e)[:120]},
+                )
+
+    def note_rehabilitated(self, target: str) -> None:
+        """The client reports a quarantined-then-healed endpoint (cool-
+        off elapsed, half-open trial re-earned CLOSED): drop the
+        persisted record, otherwise every future restart re-imposes a
+        fresh quarantine for an event the cool-off contract already
+        resolved. Cheap no-op for never-persisted targets."""
+        with self._fs_lock:
+            if self._persisted_targets is None:
+                self._persisted_targets = set(load_quarantine_file(self.dump_dir))
+            known = target in self._persisted_targets
+        if not known:
+            return
+        self.log.info(
+            "quarantined endpoint rehabilitated: clearing persisted record",
+            {"target": target},
+        )
+        self.clear_quarantine(target)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Tests: block until every sampled verdict is accounted for —
+        processed by the worker or dropped at the queue. Counter-based
+        (sampled == processed + dropped), so a record popped but not yet
+        re-verified (which can take seconds on the real oracle) still
+        counts as in flight; there is no popped-but-not-flagged window
+        to race. True when drained within the bound."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._processed + self.dropped >= self.sampled:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        self._closed = True
+        self._stop.set()
+        if self._thread.is_alive() and self._thread is not threading.current_thread():
+            self._thread.join(timeout=2.0)
+
+
+def _sanitize(target: str) -> str:
+    return "".join(ch if ch.isalnum() else "_" for ch in target)
+
+
+def _set_metadata(sets, max_sets: int = 8) -> list[dict]:
+    """Per-set forensics metadata without dumping full signatures: the
+    pubkey and message identify the validator/object, the signature
+    prefix is enough to match against the helper's logs. Built from the
+    DECODED sets (decode_sets owns the wire layout — no hand-rolled
+    offsets to drift when the frame format evolves)."""
+    out = []
+    for s in sets[:max_sets]:
+        out.append(
+            {
+                "pubkey": bytes(s.pubkey).hex(),
+                "message": bytes(s.message).hex(),
+                "signature_prefix": bytes(s.signature)[:16].hex(),
+            }
+        )
+    if len(sets) > max_sets:
+        out.append({"truncated": len(sets) - max_sets})
+    return out

@@ -36,7 +36,21 @@ closed instead of decoding as a verdict. All endpoint state
 transitions go through `self._lock`; the probe thread wakes via an
 event and is joined on close. With `lodestar_resilience_*` metrics
 attached, routed/failover/hedge counts and breaker states export per
-endpoint.
+endpoint. Breaker outcomes are token-matched: every issued RPC carries
+the generation token its `try_acquire` handed out, so a stale pre-open
+RPC's late outcome cannot re-open the breaker mid-trial or discard the
+trial's success.
+
+Byzantine auditing (`offload/audit.py`): when an `OffloadAuditor` is
+attached, every offload-served verdict is offered to its seeded sampler
+(one coin flip + a non-blocking queue put — the hot path never waits on
+re-verification) and routing becomes trust-aware: endpoints whose audit
+trust EWMA fell below `TRUST_ROUTE_THRESHOLD` serve only when no
+trusted endpoint is viable, and a QUARANTINED endpoint (caught lying by
+the auditor's independent re-check) is skipped like any circuit-open
+endpoint — but its breaker ignores probe recoveries until the cool-off
+elapses or `unquarantine_endpoint` (the `--offload-unquarantine` admin
+action) lifts it.
 """
 
 from __future__ import annotations
@@ -54,10 +68,12 @@ from lodestar_tpu.logger import get_logger
 from lodestar_tpu.scheduler import BULK_CLASSES, AdmissionState, PriorityClass
 
 from . import OffloadError, decode_status, decode_verdict, encode_sets
+from .audit import TRUST_ROUTE_THRESHOLD
 from .resilience import (
     CLASS_DEADLINE_S,
     DEFAULT_FAILURE_THRESHOLD,
     DEFAULT_MAX_RESET_TIMEOUT_S,
+    DEFAULT_QUARANTINE_COOLOFF_S,
     DEFAULT_RESET_TIMEOUT_S,
     HEDGE_CLASSES,
     BreakerState,
@@ -74,6 +90,10 @@ HEALTH_PROBE_INTERVAL_S = 2.0
 RECONNECT_BACKOFF_S = (0.5, 1.0, 2.0, 4.0, 8.0)  # then stays at the max
 
 _UNKNOWN_OCCUPANCY = 500  # rank servers that never reported between idle and pinned
+
+#: sentinel distinguishing "caller didn't specify a cool-off" from an
+#: explicit None (= indefinite quarantine, operator lift required)
+_UNSET_COOLOFF: object = object()
 
 
 def _identity(b: bytes) -> bytes:
@@ -101,6 +121,7 @@ class _Endpoint:
         "extended",
         "breaker",
         "digest_seen",
+        "was_quarantined",
     )
 
     def __init__(self, target: str, breaker: CircuitBreaker):
@@ -119,6 +140,10 @@ class _Endpoint:
         # sticky: once this server has spoken the digest-checked verdict
         # format, a bare legacy frame is a truncation/downgrade, not compat
         self.digest_seen = False
+        # set when THIS session quarantined the endpoint: gates the
+        # rehabilitation cleanup so a fresh CLOSED endpoint at startup
+        # can't wipe a persisted record before the node re-applies it
+        self.was_quarantined = False
 
     def state(self) -> dict:
         return {
@@ -155,6 +180,8 @@ class BlsOffloadClient(IBlsVerifier):
         hedge_classes: frozenset[PriorityClass] | None = None,
         metrics=None,
         transport_wrapper=None,
+        auditor=None,
+        quarantine_cooloff_s: float | None = DEFAULT_QUARANTINE_COOLOFF_S,
     ) -> None:
         targets = [target] if isinstance(target, str) else list(target)
         if not targets:
@@ -172,6 +199,13 @@ class BlsOffloadClient(IBlsVerifier):
         # wrapper(target, method_name, callable) -> callable around every
         # stub the client dials
         self._transport_wrapper = transport_wrapper
+        # OffloadAuditor (offload/audit.py) or None: sampled verdicts are
+        # cross-verified off the hot path; Byzantine events quarantine
+        # the endpoint through the callback bound here
+        self._auditor = auditor
+        self.quarantine_cooloff_s = quarantine_cooloff_s
+        if auditor is not None:
+            auditor.bind(self.quarantine_endpoint)
         self._class_deadlines = dict(class_deadlines or CLASS_DEADLINE_S)
         self._hedge_classes = HEDGE_CLASSES if hedge_classes is None else hedge_classes
         self._lock = threading.Lock()
@@ -273,6 +307,29 @@ class BlsOffloadClient(IBlsVerifier):
             ep.extended = frame.extended
         if not was_healthy and frame.can_accept:
             self.log.info(f"offload service {ep.target} is back")
+        # the quarantine gauge is event-driven on entry but a cool-off
+        # expires LAZILY (the next trial clears the flag with no client
+        # code running) — refresh it here so the dashboard converges
+        # within one probe interval of the self-heal, and drop the
+        # persisted record once the endpoint re-earned CLOSED (else
+        # every restart re-imposes a quarantine the cool-off contract
+        # already resolved)
+        if self._auditor is not None:
+            quarantined = ep.breaker.is_quarantined
+            rehabilitated = False
+            with self._lock:
+                if (
+                    ep.was_quarantined
+                    and not quarantined
+                    and ep.breaker.state() is BreakerState.CLOSED
+                ):
+                    ep.was_quarantined = False
+                    rehabilitated = True
+            # auditor calls outside the client lock: note_rehabilitated
+            # does file I/O and must not stall the hot path's routing
+            self._auditor.note_quarantine(ep.target, quarantined)
+            if rehabilitated:
+                self._auditor.note_rehabilitated(ep.target)
         return True
 
     def _probe_loop(self) -> None:
@@ -313,17 +370,30 @@ class BlsOffloadClient(IBlsVerifier):
 
     # -- routing ---------------------------------------------------------------
 
+    def _trust(self, target: str) -> float:
+        """Audit trust EWMA for routing (1.0 when no auditor runs)."""
+        return 1.0 if self._auditor is None else self._auditor.trust_value(target)
+
     def _pick_endpoint(
         self, priority: PriorityClass, exclude: tuple[_Endpoint, ...] = ()
-    ) -> _Endpoint | None:
+    ) -> tuple[_Endpoint, int | None] | None:
         """Least-occupied closed-breaker healthy endpoint whose admission
         state admits this class; bulk work skips SHED_BULK servers while
         any endpoint still ACCEPTs. Degrades to any-healthy, then to any
         closed-breaker endpoint (the verify RPC then fails closed on its
         own). Endpoints whose breaker is open are skipped WITHOUT dialing
-        — when none is closed, at most one half-open trial is admitted;
-        None means every endpoint is circuit-open (caller fails fast and
-        the degradation chain takes over).
+        — quarantined ones stay skipped through their whole cool-off —
+        and when none is closed, at most one half-open trial is
+        admitted; None means every endpoint is circuit-open (caller
+        fails fast and the degradation chain takes over). Returns the
+        endpoint plus the breaker generation token its admission handed
+        out, so the RPC's outcome is matched to this exact attempt.
+
+        Trust-aware: with an auditor attached, endpoints whose audit
+        trust fell below `TRUST_ROUTE_THRESHOLD` are demoted — they
+        serve only when no trusted candidate is viable. (Quarantine
+        handles the caught-lying case outright; low trust covers the
+        gray zone of arbitrated helper-vs-helper disagreements.)
 
         Recovery: an OPEN endpoint whose reset delay elapsed gets its
         half-open trial EVEN while closed endpoints exist — otherwise a
@@ -346,12 +416,18 @@ class BlsOffloadClient(IBlsVerifier):
                         ep not in closed
                         and ep.healthy
                         and ep.breaker.seconds_until_trial() == 0.0
-                        and ep.breaker.try_acquire()
                     ):
-                        return ep
+                        token = ep.breaker.try_acquire()
+                        if token is not None:
+                            return ep, token
             if closed:
                 healthy = [ep for ep in closed if ep.healthy]
                 cands = [ep for ep in healthy if ep.admission is not AdmissionState.REJECT]
+                trusted = [
+                    ep for ep in cands if self._trust(ep.target) >= TRUST_ROUTE_THRESHOLD
+                ]
+                if trusted:
+                    cands = trusted
                 if priority in BULK_CLASSES:
                     accepting = [
                         ep for ep in cands if ep.admission is AdmissionState.ACCEPT
@@ -360,18 +436,88 @@ class BlsOffloadClient(IBlsVerifier):
                         cands = accepting
                 if not cands:
                     cands = healthy or closed
-                return min(cands, key=_occupancy_key)
-            # no closed breaker left: probe the least-loaded endpoint that
-            # admits a half-open trial (try_acquire consumes the slot)
+                # the chosen breaker can open between the state() read
+                # and acquisition (outcomes land without the client
+                # lock): retry the NEXT-best candidate so the healthy/
+                # admission/trust filters still hold, rather than
+                # falling straight to the unfiltered trial scan
+                while cands:
+                    best = min(cands, key=_occupancy_key)
+                    token = best.breaker.try_acquire()
+                    if token is not None:
+                        return best, token
+                    cands = [ep for ep in cands if ep is not best]
+            # no closed breaker admitted work: probe the least-loaded
+            # endpoint that admits a half-open trial (try_acquire
+            # consumes the slot)
             for ep in sorted(pool, key=_occupancy_key):
-                if ep.breaker.try_acquire():
-                    return ep
+                token = ep.breaker.try_acquire()
+                if token is not None:
+                    return ep, token
             return None
 
     def endpoint_states(self) -> list[dict]:
         """Probe-refreshed view per endpoint (debugging/metrics/tests)."""
         with self._lock:
-            return [ep.state() for ep in self._endpoints]
+            out = []
+            for ep in self._endpoints:
+                st = ep.state()
+                st["quarantined"] = ep.breaker.is_quarantined
+                st["trust"] = round(self._trust(ep.target), 4)
+                out.append(st)
+            return out
+
+    def quarantine_endpoint(
+        self, target: str, cooloff_s: "float | None" = _UNSET_COOLOFF, reason: str = ""
+    ) -> bool:
+        """Byzantine quarantine (the auditor's bound callback, also an
+        admin/test entry point): force the endpoint's breaker open with
+        the quarantine flag — skipped by routing, immune to probe
+        recoveries — until the cool-off elapses or unquarantine. The
+        endpoint's in-flight work fails over through normal resilience
+        (hedge/degradation chain); nothing is aborted mid-RPC.
+
+        `cooloff_s=None` means INDEFINITE (an auditor configured for
+        operator-only lifts passes it through verbatim); omitting the
+        argument uses the client's configured cool-off."""
+        cool = self.quarantine_cooloff_s if cooloff_s is _UNSET_COOLOFF else cooloff_s
+        hit = False
+        with self._lock:
+            # breaker calls are safe under the client lock (its
+            # transition sink is metrics/log only, per the init comment)
+            for ep in self._endpoints:
+                if ep.target == target:
+                    ep.breaker.quarantine(cool)
+                    ep.was_quarantined = True
+                    hit = True
+        if hit:
+            self.log.error(
+                "offload endpoint QUARANTINED",
+                {"target": target, "cooloff_s": cool, "reason": reason or "admin"},
+            )
+            if self._auditor is not None:
+                self._auditor.note_quarantine(target, True)
+        return hit
+
+    def unquarantine_endpoint(self, target: str) -> bool:
+        """Operator lift (--offload-unquarantine): clears the flag and
+        cool-off; the endpoint still re-earns CLOSED through one
+        half-open trial. Also clears the persisted quarantine record so
+        a restart doesn't re-apply it."""
+        hit = False
+        with self._lock:
+            for ep in self._endpoints:
+                if ep.target == target:
+                    ep.was_quarantined = False  # lift handles the persistence
+                    if ep.breaker.is_quarantined:
+                        ep.breaker.unquarantine()
+                        hit = True
+        if hit:
+            self.log.warn("offload endpoint quarantine lifted", {"target": target})
+        if self._auditor is not None:
+            self._auditor.note_quarantine(target, False)
+            self._auditor.clear_quarantine(target)
+        return hit
 
     def _deadline_for(self, priority: PriorityClass) -> float:
         return deadline_for(priority, cap=self.timeout_s, deadlines=self._class_deadlines)
@@ -422,9 +568,10 @@ class BlsOffloadClient(IBlsVerifier):
             if remaining <= 0:
                 break
             attempt_deadline = min(deadline / max_attempts, remaining) if attempt == 0 else remaining
-            ep = self._pick_endpoint(priority, exclude=tried)
-            if ep is None:
+            picked = self._pick_endpoint(priority, exclude=tried)
+            if picked is None:
                 break
+            ep, token = picked
             tried = tried + (ep,)
             if attempt > 0:
                 self._note_hedge(tried[0], ep, priority, trace_parent)
@@ -438,7 +585,7 @@ class BlsOffloadClient(IBlsVerifier):
                 verdict = await loop.run_in_executor(
                     None,
                     self._call_endpoint,
-                    ep, frame, n_sets, priority, attempt_deadline, trace_hdr, trace_parent,
+                    ep, token, frame, n_sets, priority, attempt_deadline, trace_hdr, trace_parent,
                 )
                 if attempt > 0 and m is not None:
                     m.hedge_wins.labels(priority.label).inc()
@@ -474,6 +621,7 @@ class BlsOffloadClient(IBlsVerifier):
     def _call_endpoint(
         self,
         ep: _Endpoint,
+        token: int | None,
         frame: bytes,
         n_sets: int,
         priority: PriorityClass,
@@ -482,7 +630,9 @@ class BlsOffloadClient(IBlsVerifier):
         trace_parent,
     ) -> bool:
         """One verify RPC on `ep` (runs on an executor thread). Breaker
-        outcome and endpoint health are recorded on every exit path."""
+        outcome and endpoint health are recorded on every exit path,
+        token-matched to the attempt that acquired admission — a stale
+        pre-open RPC resolving late cannot perturb a half-open trial."""
         # clock reads only on the traced path: untraced RPCs pay just
         # the trace_hdr None-checks
         t0 = time.monotonic_ns() if trace_hdr is not None else 0
@@ -501,15 +651,22 @@ class BlsOffloadClient(IBlsVerifier):
             # or a digest that doesn't bind this request to this verdict —
             # trailing spans still came home and must be grafted below
             verdict = decode_verdict(resp, request=frame, require_digest=ep.digest_seen)
-            ep.breaker.record_success()
+            ep.breaker.record_success(token)
             with self._lock:
                 ep.healthy = True
                 if len(resp) > 1:
                     ep.digest_seen = True
+            # Byzantine audit touchpoint: one seeded coin flip and a
+            # non-blocking enqueue — re-verification happens on the
+            # auditor's own thread, never on this (hot-path) one
+            if self._auditor is not None:
+                self._auditor.observe(
+                    ep.target, frame, n_sets, verdict, priority, trace_hdr
+                )
             return verdict
         except grpc.RpcError as e:
             err = str(e.code())
-            ep.breaker.record_failure()
+            ep.breaker.record_failure(token)
             with self._lock:
                 ep.healthy = False  # probe loop takes over reconnection
             raise OffloadError(f"offload transport: {e.code()}") from e
@@ -517,7 +674,7 @@ class BlsOffloadClient(IBlsVerifier):
             err = str(e)[:120]
             # a server answering with error/corrupt frames is sick even
             # though its transport is up: count toward the breaker
-            ep.breaker.record_failure()
+            ep.breaker.record_failure(token)
             raise
         except Exception as e:
             # anything else (e.g. 'Cannot invoke RPC on closed channel'
@@ -526,7 +683,7 @@ class BlsOffloadClient(IBlsVerifier):
             # blacklist the endpoint forever — and fails closed like
             # every other offload error
             err = f"{type(e).__name__}: {e}"[:120]
-            ep.breaker.record_failure()
+            ep.breaker.record_failure(token)
             raise OffloadError(err) from e
         finally:
             # the RPC span is recorded on EVERY exit path — a failing
@@ -574,6 +731,11 @@ class BlsOffloadClient(IBlsVerifier):
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
+        if self._auditor is not None:
+            # the audit worker may be mid-re-verification (seconds of
+            # CPU on a bulk frame): join it off the event loop, same
+            # treatment as the probe join below
+            await asyncio.get_event_loop().run_in_executor(None, self._auditor.close)
         probe = self._probe_thread
         if probe.is_alive() and probe is not threading.current_thread():
             # probe RPC timeouts are <= 2s, so the join is bounded; run it

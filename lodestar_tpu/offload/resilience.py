@@ -23,6 +23,27 @@ bound that damage window:
   point: once verification is outsourced, the tail of the offload RPC
   IS the tail of block import.
 
+Two refinements on the breaker itself:
+
+* Trial tokens — `try_acquire` hands out a generation token (the
+  breaker's transition epoch at admission); `record_success/_failure`
+  accept it back and IGNORE outcomes whose token is stale. A long RPC
+  issued before the breaker opened can therefore neither re-open the
+  breaker mid-trial (discarding the trial's success) nor close it from
+  a success that predates the failures — outcomes are matched to the
+  attempt that acquired them. Tokenless calls keep the old
+  window-heuristic behavior (the pool's wedge breaker gates on
+  `is_open` alone and never acquires).
+
+* Quarantine — `quarantine(cooloff_s)` forces the breaker open with a
+  flag that a Status-probe recovery does NOT release
+  (`note_probe_success` is a transport-health signal; quarantine means
+  the endpoint LIED, which transport health says nothing about). The
+  flag survives until the operator-tunable cool-off elapses (then one
+  half-open trial re-earns trust the normal way) or `unquarantine()`
+  is called (the `--offload-unquarantine` admin action).
+
+
 Dependency-light by design: imports only stdlib + scheduler + utils, so
 `chain/bls` (device-pool wedge detection) and `offload/client.py` both
 use it without cycles.
@@ -46,6 +67,7 @@ __all__ = [
     "DEFAULT_FAILURE_THRESHOLD",
     "DEFAULT_RESET_TIMEOUT_S",
     "DEFAULT_MAX_RESET_TIMEOUT_S",
+    "DEFAULT_QUARANTINE_COOLOFF_S",
     "deadline_for",
 ]
 
@@ -56,6 +78,12 @@ __all__ = [
 DEFAULT_FAILURE_THRESHOLD = 5
 DEFAULT_RESET_TIMEOUT_S = 2.0
 DEFAULT_MAX_RESET_TIMEOUT_S = 30.0
+
+#: quarantine cool-off after a Byzantine event (offload/audit.py). Long
+#: by design: a helper caught lying is not a flapping transport — 15
+#: minutes keeps an operator in the loop while still self-healing
+#: unattended deployments. 0/None = quarantined until unquarantine().
+DEFAULT_QUARANTINE_COOLOFF_S = 900.0
 
 
 class BreakerState(enum.IntEnum):
@@ -136,12 +164,28 @@ class CircuitBreaker:
         self._open_streak = 0  # consecutive opens -> exponential reset delay
         self._retry_at = 0.0
         self._trial_inflight = False
+        # generation token: bumped on EVERY state transition, handed out
+        # by try_acquire; outcomes carrying a stale token are ignored
+        self._epoch = 1
+        # Byzantine quarantine (offload/audit.py): forced-open with a
+        # flag probe recoveries don't release; _retry_at holds the
+        # cool-off deadline (inf = until unquarantine())
+        self._quarantined = False
 
     # -- queries ---------------------------------------------------------------
 
     def state(self) -> BreakerState:
         with self._lock:
             return self._state
+
+    @property
+    def is_quarantined(self) -> bool:
+        """True while the quarantine cool-off still gates the endpoint.
+        Once the cool-off elapses the breaker behaves like any OPEN
+        breaker past its delay (one half-open trial re-earns trust);
+        the flag itself is cleared lazily by that trial."""
+        with self._lock:
+            return self._quarantined and self._clock() < self._retry_at
 
     @property
     def is_open(self) -> bool:
@@ -163,31 +207,47 @@ class CircuitBreaker:
 
     # -- admission -------------------------------------------------------------
 
-    def try_acquire(self) -> bool:
-        """May a request be issued now? CLOSED always admits. OPEN past
-        its reset delay flips to HALF_OPEN and admits exactly one trial;
-        the trial slot is held until record_success/record_failure."""
+    def try_acquire(self) -> int | None:
+        """May a request be issued now? Returns a generation TOKEN (a
+        truthy int — existing boolean callers keep working) when
+        admitted, None when refused. Pass the token back to
+        record_success/record_failure so the outcome is matched to this
+        attempt: outcomes from a stale generation (the breaker
+        transitioned since) are ignored instead of perturbing a trial.
+
+        CLOSED always admits. OPEN past its reset delay flips to
+        HALF_OPEN and admits exactly one trial; the trial slot is held
+        until record_success/record_failure."""
         fire: tuple[BreakerState, BreakerState] | None = None
         with self._lock:
             if self._state is BreakerState.CLOSED:
-                return True
+                return self._epoch
             if self._state is BreakerState.OPEN and self._clock() >= self._retry_at:
                 fire = (self._state, BreakerState.HALF_OPEN)
                 self._state = BreakerState.HALF_OPEN
+                self._epoch += 1
+                self._quarantined = False  # cool-off elapsed: trial re-earns trust
                 self._trial_inflight = True
+                token = self._epoch
             elif self._state is BreakerState.HALF_OPEN and not self._trial_inflight:
                 self._trial_inflight = True
-                return True
+                return self._epoch
             else:
-                return False
+                return None
         self._emit(fire)
-        return True
+        return token
 
     # -- outcomes --------------------------------------------------------------
 
-    def record_success(self) -> None:
+    def record_success(self, token: int | None = None) -> None:
         fire: tuple[BreakerState, BreakerState] | None = None
         with self._lock:
+            if token is not None and token != self._epoch:
+                # stale generation: the breaker transitioned since this
+                # attempt was admitted (e.g. opened under it) — a
+                # long-delayed success from before the failures is not
+                # evidence about the endpoint NOW
+                return
             self._failures = 0
             if self._state is BreakerState.OPEN and self._clock() < self._retry_at:
                 # a STALE success: an RPC issued before the breaker
@@ -201,12 +261,21 @@ class CircuitBreaker:
             if self._state is not BreakerState.CLOSED:
                 fire = (self._state, BreakerState.CLOSED)
                 self._state = BreakerState.CLOSED
+                self._epoch += 1
+                self._quarantined = False
                 self._open_streak = 0
         self._emit(fire)
 
-    def record_failure(self) -> None:
+    def record_failure(self, token: int | None = None) -> None:
         fire: tuple[BreakerState, BreakerState] | None = None
         with self._lock:
+            if token is not None and token != self._epoch:
+                # stale generation: a failure from a pre-open RPC must
+                # not re-open the breaker mid-trial (it would discard
+                # the in-flight trial's success) nor double-count into a
+                # fresh CLOSED streak — the attempt it belongs to
+                # already resolved its era
+                return
             self._trial_inflight = False
             self._failures += 1
             # a failure while OPEN past the reset delay is a failed trial
@@ -233,16 +302,52 @@ class CircuitBreaker:
                 )
                 self._open_streak += 1
                 self._state = BreakerState.OPEN
+                self._epoch += 1
+                self._quarantined = False  # a plain failure era replaces quarantine
                 self._retry_at = self._clock() + delay
         self._emit(fire)
 
     def note_probe_success(self) -> None:
         """Out-of-band evidence the endpoint is back (a Status probe
         answered): release the open-wait so the next verify becomes the
-        half-open trial instead of sitting out the full reset delay."""
+        half-open trial instead of sitting out the full reset delay.
+        A QUARANTINED breaker is exempt: quarantine means the endpoint
+        lied while its transport was perfectly healthy — a live Status
+        probe is exactly zero evidence against that."""
         with self._lock:
-            if self._state is BreakerState.OPEN:
+            if self._state is BreakerState.OPEN and not self._quarantined:
                 self._retry_at = self._clock()
+
+    # -- quarantine ------------------------------------------------------------
+
+    def quarantine(self, cooloff_s: float | None = None) -> None:
+        """Force the breaker open for a Byzantine event (offload/audit):
+        no trials, no probe release, until `cooloff_s` elapses (then ONE
+        half-open trial re-earns trust) or unquarantine(). None/0 means
+        quarantined indefinitely — operator action required."""
+        fire: tuple[BreakerState, BreakerState] | None = None
+        with self._lock:
+            if self._state is not BreakerState.OPEN:
+                fire = (self._state, BreakerState.OPEN)
+            self._state = BreakerState.OPEN
+            self._epoch += 1  # in-flight outcomes from before the event are void
+            self._quarantined = True
+            self._trial_inflight = False
+            self._retry_at = (
+                self._clock() + cooloff_s if cooloff_s else float("inf")
+            )
+        self._emit(fire)
+
+    def unquarantine(self) -> None:
+        """Operator lift (--offload-unquarantine): drop the flag and the
+        cool-off so the next request becomes the half-open trial — the
+        endpoint still re-earns CLOSED through a successful trial rather
+        than being trusted outright."""
+        with self._lock:
+            if not self._quarantined:
+                return
+            self._quarantined = False
+            self._retry_at = self._clock()
 
     # -- internals -------------------------------------------------------------
 

@@ -150,6 +150,20 @@ class RangeSync:
                 batch.status = BatchStatus.PROCESSED
                 next_to_process += 1
             except Exception as e:
+                # same exemption as the gossip processor: a rejection the
+                # chain marked as caused by a LOCAL verifier outage says
+                # nothing about the peer OR the batch. Re-downloading from
+                # another peer cannot help and would burn the attempt
+                # budget (terminally failing the batch) within seconds of
+                # a transient outage — end this sync round instead; the
+                # sync driver re-syncs the gap once the verifier is back.
+                if getattr(e, "verifier_outage", False):
+                    self.log.warn(
+                        "segment rejected during verifier outage: pausing sync "
+                        "round, peer not downscored"
+                    )
+                    batch.status = BatchStatus.AWAITING_PROCESSING
+                    return SyncResult(False, processed, failed_batch=batch)
                 batch.processing_attempts += 1
                 self.on_peer_downscore(batch.peer, f"invalid segment: {e!r}")
                 self.log.warn(

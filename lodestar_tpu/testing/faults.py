@@ -25,10 +25,13 @@ rules stay exact regardless).
 
 Verdict-flip scope: `FLIP_VERDICT` flips the verdict byte of a
 well-formed reply IN FLIGHT — the digest check (`decode_verdict`)
-catches it and the client fails closed. A byzantine SERVER that lies
-about the verdict and signs its lie correctly is outside this model;
-that threat needs independent re-verification (the degradation chain)
-or multi-helper cross-checking (2G2T in PAPERS.md).
+catches it and the client fails closed. `LIE_VERDICT` is the byzantine
+SERVER: it flips the verdict AND recomputes the digest over the lie,
+producing a frame that is indistinguishable from an honest verdict at
+the protocol layer — by construction NOTHING in the framing catches
+it; only independent re-verification (the audit subsystem's 2G2T-style
+random cross-checks, `offload/audit.py`) can, which is exactly the
+property its tests prove.
 """
 
 from __future__ import annotations
@@ -54,6 +57,7 @@ class FaultKind(enum.Enum):
     ERROR_FRAME = "error_frame"  # server answers with an error frame
     CORRUPT_VERDICT = "corrupt_verdict"  # seeded bit-flip/truncation of the reply
     FLIP_VERDICT = "flip_verdict"  # flip the verdict byte, leave the digest
+    LIE_VERDICT = "lie_verdict"  # byzantine: flip the verdict AND re-sign the lie
     PARTITION = "partition"  # every call to the target fails instantly
 
 
@@ -201,9 +205,13 @@ class FaultInjector:
         client uses)."""
         return _FaultyCallable(self, target, method, fn)
 
-    def _pre_call(self, target: str, method: str, timeout: float | None):
+    def _pre_call(
+        self, target: str, method: str, timeout: float | None, request: bytes = b""
+    ):
         """Faults decided before the wire: may sleep, may raise. Returns
-        (response_override, response_mutator)."""
+        (response_override, response_mutator). `request` feeds the
+        LIE_VERDICT mutator — a byzantine server signs its lie over the
+        request it actually received."""
         kind, rule, _idx = self._next_fault(target, method)
         if kind is None:
             return None, None
@@ -236,6 +244,8 @@ class FaultInjector:
             return None, self._corrupt
         if kind is FaultKind.FLIP_VERDICT:
             return None, _flip_verdict_byte
+        if kind is FaultKind.LIE_VERDICT:
+            return None, lambda data: _lie_verdict(data, request)
         return None, None
 
     # -- backend seam ----------------------------------------------------------
@@ -277,6 +287,24 @@ def _flip_verdict_byte(data: bytes) -> bytes:
     return data
 
 
+def _lie_verdict(data: bytes, request: bytes) -> bytes:
+    """The byzantine helper: flip the verdict and RE-SIGN the lie — the
+    digest is recomputed over (request || lied_verdict), so the frame
+    passes every protocol-layer check (`decode_verdict` accepts it).
+    Distinct from FLIP_VERDICT, which framing catches: this fault is
+    only detectable by independently re-verifying the signature sets
+    (offload/audit.py). Legacy 1-byte verdicts just flip (no digest to
+    forge); error frames pass through (already fail-closed)."""
+    if not data or data[0] not in (0, 1):
+        return data
+    lied = 1 - data[0]
+    if len(data) == 1:
+        return bytes([lied])
+    from lodestar_tpu.offload import encode_verdict
+
+    return encode_verdict(bool(lied), request=request)
+
+
 class _FaultyCallable:
     """Stub wrapper: fault gate in front of the real call, response
     mutation behind it."""
@@ -288,7 +316,9 @@ class _FaultyCallable:
         self._fn = fn
 
     def __call__(self, request: bytes, timeout: float | None = None, metadata=None):
-        override, mutate = self._injector._pre_call(self._target, self._method, timeout)
+        override, mutate = self._injector._pre_call(
+            self._target, self._method, timeout, request
+        )
         if override is not None:
             return override
         kwargs = {"timeout": timeout}
@@ -298,7 +328,9 @@ class _FaultyCallable:
         return mutate(resp) if mutate is not None else resp
 
     def with_call(self, request: bytes, timeout: float | None = None, metadata=None):
-        override, mutate = self._injector._pre_call(self._target, self._method, timeout)
+        override, mutate = self._injector._pre_call(
+            self._target, self._method, timeout, request
+        )
         if override is not None:
             return override, _NullCall()
         kwargs = {"timeout": timeout}

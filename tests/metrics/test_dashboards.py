@@ -93,3 +93,28 @@ def test_gen_dashboards_regen_is_noop(tmp_path):
         assert (tmp_path / name).read_text() == (DASHBOARDS / name).read_text(), (
             f"{name} is stale: run `python tools/gen_dashboards.py`"
         )
+
+
+def test_audit_dashboard_covers_every_audit_metric():
+    """Both directions for the audit family: every expr token in the
+    audit dashboard exists in the registry (the general test), AND every
+    lodestar_offload_audit_* family registered in metrics/__init__.py is
+    actually panelled — a new audit metric without a panel is a blind
+    spot in the one dashboard operators watch during an incident.
+    (prometheus_client appends _total to counters: the expr must use the
+    suffixed sample name, which _registry_sample_names() encodes.)"""
+    dash = json.loads((DASHBOARDS / "lodestar_offload_audit.json").read_text())
+    exprs = " ".join(t["expr"] for p in dash["panels"] for t in p.get("targets", []))
+
+    m = create_metrics()
+    audit_families = [
+        f for f in m.creator.registry.collect() if f.name.startswith("lodestar_offload_audit")
+    ]
+    assert len(audit_families) >= 8, "expected the full AuditMetrics family"
+    for family in audit_families:
+        sample = family.name + "_total" if family.type == "counter" else family.name
+        assert sample in exprs, f"audit metric {sample} has no panel"
+    # the non-negotiable incident panels
+    assert "lodestar_offload_audit_trust_score" in exprs
+    assert "lodestar_offload_audit_quarantined" in exprs
+    assert "lodestar_offload_audit_byzantine_total" in exprs

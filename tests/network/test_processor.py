@@ -135,3 +135,66 @@ def test_default_handlers_end_to_end(minimal_preset):
         assert chain.attestation_pool.get_aggregate(1, root) is not None
 
     asyncio.run(go())
+
+
+def test_verifier_outage_rejections_do_not_downscore_peers(minimal_preset):
+    """Breaker-aware gossip scoring: an invalid-signature rejection
+    downscores the sender, but the SAME rejection produced while the
+    whole degradation chain is down (verifier outage) is a local
+    incident — the honest peer keeps its score."""
+    from lodestar_tpu.chain.bls import DegradingBlsVerifier
+    from lodestar_tpu.chain.bls.interface import IBlsVerifier
+    from lodestar_tpu.metrics import create_metrics
+
+    p = minimal_preset
+    sks = interop_secret_keys(N)
+    genesis = create_interop_genesis_state(N, p=p)
+
+    class _Erring(IBlsVerifier):
+        async def verify_signature_sets(self, sets, opts=None):
+            raise RuntimeError("offload down")
+
+        def can_accept_work(self):
+            return True
+
+        async def close(self):
+            return None
+
+    async def go():
+        # 1. genuine invalid signatures -> REJECT -> downscore
+        reports = []
+        chain = BeaconChain(
+            anchor_state=genesis,
+            bls_verifier=BlsVerifierMock(False),
+            db=MemoryDbController(),
+            p=p,
+            current_slot=2,
+        )
+        proc = NetworkProcessor(chain, report_peer=lambda peer, why: reports.append(peer))
+        proc.push("beacon_block", _empty_block_at(genesis, 1, sks, p), peer="peerA")
+        await proc.execute_work()
+        assert proc.errors == 1
+        assert reports == ["peerA"]
+
+        # 2. same block, verifier OUTAGE -> rejected but NOT downscored
+        reports2 = []
+        metrics = create_metrics()
+        deg = DegradingBlsVerifier([("offload", _Erring())], metrics=metrics.resilience)
+        chain2 = BeaconChain(
+            anchor_state=genesis,
+            bls_verifier=deg,
+            db=MemoryDbController(),
+            p=p,
+            current_slot=2,
+        )
+        proc2 = NetworkProcessor(
+            chain2, metrics=metrics, report_peer=lambda peer, why: reports2.append(peer)
+        )
+        proc2.push("beacon_block", _empty_block_at(genesis, 1, sks, p), peer="peerB")
+        await proc2.execute_work()
+        assert proc2.errors == 1  # the block DID reject (fail closed holds)
+        assert deg.in_outage()
+        assert reports2 == []  # ... but the honest peer was spared
+        assert metrics.resilience.outage_unscored._value.get() == 1
+
+    asyncio.run(go())

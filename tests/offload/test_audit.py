@@ -1,0 +1,729 @@
+"""Byzantine offload auditing (offload/audit.py): seeded sampler
+determinism, trust EWMA semantics, CPU-budget duty cycling, and the
+acceptance invariant — a helper that lies and SIGNS its lie (the fault
+the digest check cannot catch) is detected within the 2G2T sampling
+bound, quarantined (probe-immune, persisted), forensics-dumped with
+both verdicts, and routed around without rejecting a valid block — all
+while re-verification never runs on the block-import hot path."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from lodestar_tpu import params, tracing
+from lodestar_tpu.chain.bls import BlsSingleThreadVerifier, DegradingBlsVerifier
+from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
+from lodestar_tpu.crypto.bls import api as bls
+from lodestar_tpu.crypto.bls.api import SignatureSet, verify_signature_sets
+from lodestar_tpu.metrics import create_metrics
+from lodestar_tpu.offload.audit import (
+    AuditSampler,
+    OffloadAuditor,
+    TrustScore,
+    cross_helper_reference,
+    detection_horizon,
+)
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.state_transition.genesis import interop_secret_keys
+from lodestar_tpu.testing import FaultInjector, FaultKind, FaultRule
+
+_GOSSIP = VerifySignatureOpts(priority=int(PriorityClass.GOSSIP_BLOCK))
+
+#: audit rate for the invariant tests; horizon = ceil(ln .01 / ln .5) = 7
+_RATE = 0.5
+
+
+def _dummy_sets(n: int = 1) -> list[SignatureSet]:
+    return [
+        SignatureSet(pubkey=bytes([i + 1]) * 48, message=bytes([i]) * 32, signature=bytes([i]) * 96)
+        for i in range(n)
+    ]
+
+
+def _tampered_sets(n: int = 1) -> list[SignatureSet]:
+    """REAL keys, broken signature: the CPU oracle genuinely verifies
+    these to False — a helper claiming True is provably lying."""
+    sks = interop_secret_keys(n)
+    out = []
+    for i, sk in enumerate(sks):
+        msg = bytes([i]) * 32
+        out.append(
+            SignatureSet(
+                pubkey=sk.to_pubkey(), message=msg, signature=bls.sign(sk, b"\xee" * 32)
+            )
+        )
+    return out
+
+
+def _stub_reference(verdict: bool = False):
+    """Trusted-oracle stand-in for opaque wire-shaped sets."""
+    return lambda sets, exclude_target: (verdict, None)
+
+
+# -- sampler ------------------------------------------------------------------
+
+
+def test_sampler_same_seed_same_stream_identical_picks():
+    stream = [PriorityClass(i % 5) for i in range(200)]
+    a = AuditSampler(0.3, seed=1234)
+    b = AuditSampler(0.3, seed=1234)
+    picks_a = [a.sample(p) for p in stream]
+    picks_b = [b.sample(p) for p in stream]
+    assert picks_a == picks_b
+    assert any(picks_a) and not all(picks_a)
+    # a different seed reorders the picks (the draws are the stream)
+    c = AuditSampler(0.3, seed=1235)
+    assert [c.sample(p) for p in stream] != picks_a
+
+
+def test_sampler_gossip_sampled_more_aggressively_than_bulk():
+    s = AuditSampler(0.2)
+    assert s.rate_for(PriorityClass.GOSSIP_BLOCK) == pytest.approx(0.2)
+    assert s.rate_for(PriorityClass.GOSSIP_ATTESTATION) == pytest.approx(0.2)
+    assert s.rate_for(PriorityClass.GOSSIP_BLOCK) > s.rate_for(PriorityClass.API)
+    assert s.rate_for(PriorityClass.API) > s.rate_for(PriorityClass.RANGE_SYNC)
+    assert s.rate_for(PriorityClass.RANGE_SYNC) > s.rate_for(PriorityClass.BACKFILL)
+    # rate 1.0 on a gossip class samples EVERY verdict (draw < 1.0 always)
+    s1 = AuditSampler(1.0, seed=7)
+    assert all(s1.sample(PriorityClass.GOSSIP_BLOCK) for _ in range(64))
+
+
+def test_detection_horizon_bound():
+    # ceil(ln 0.01 / ln(1-r)): the verdicts a full-time liar survives
+    # with probability 1%
+    assert detection_horizon(0.5) == 7
+    assert detection_horizon(0.25) == 17
+    assert detection_horizon(0.05) == 90
+
+
+def test_trust_score_fast_to_lose_slow_to_earn():
+    t = TrustScore()
+    assert t.score == 1.0
+    t.record(False)
+    after_one_lie = t.score
+    assert after_one_lie <= 0.75
+    # many agreements claw trust back only gradually
+    for _ in range(3):
+        t.record(True)
+    assert t.score < 0.95
+    for _ in range(20):
+        t.record(True)
+    assert t.score > 0.95
+    assert t.agrees == 23 and t.disagrees == 1
+
+
+# -- auditor core -------------------------------------------------------------
+
+
+def test_auditor_determinism_same_seed_same_verdict_stream():
+    """Same seed + same verdict stream => identical sample picks, so a
+    chaos-soak audit run replays exactly."""
+
+    def run():
+        aud = OffloadAuditor(
+            sampler=AuditSampler(0.5, seed=99), reference=_stub_reference(False)
+        )
+        picks = []
+        frame_sets = _dummy_sets()
+        from lodestar_tpu.offload import encode_sets
+
+        frame = encode_sets(frame_sets)
+        for i in range(64):
+            pri = PriorityClass(i % 5)
+            picks.append(aud.observe("ep", frame, 1, False, pri))
+        assert aud.drain()
+        aud.close()
+        return picks, aud.audited
+
+    picks_a, audited_a = run()
+    picks_b, audited_b = run()
+    assert picks_a == picks_b
+    assert audited_a == audited_b == sum(picks_a)
+
+
+def test_auditor_respects_cpu_budget_under_saturation():
+    """Duty-cycle cap: with budget b, t seconds of re-verification CPU
+    buys t*(1-b)/b of enforced idle — a saturating sample stream cannot
+    eat more than b of one core. The reference BURNS cpu (the budget
+    charges thread CPU time; pure waiting, e.g. a helper RPC, is free)."""
+    work_s = 0.01
+    budget = 0.2
+
+    def slow_reference(sets, exclude_target):
+        t0 = time.thread_time()
+        while time.thread_time() - t0 < work_s:
+            pass  # busy: simulate oracle pairing work
+        return False, None
+
+    aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0),
+        reference=slow_reference,
+        budget=budget,
+        queue_max=64,
+    )
+    from lodestar_tpu.offload import encode_sets
+
+    frame = encode_sets(_dummy_sets())
+    n = 10
+    t0 = time.monotonic()
+    for _ in range(n):
+        assert aud.observe("ep", frame, 1, True, PriorityClass.GOSSIP_BLOCK)
+    assert aud.drain(timeout_s=15.0)
+    elapsed = time.monotonic() - t0
+    aud.close()
+    assert aud.audited == n
+    # n*work of audit CPU must stretch to >= ~n*work/budget of wall time
+    # (the last item's idle tail may fall outside drain; keep margin)
+    assert elapsed >= (n - 1) * work_s / budget * 0.6, elapsed
+
+
+def test_auditor_bounded_queue_sheds_instead_of_blocking():
+    gate = threading.Event()
+
+    def blocked_reference(sets, exclude_target):
+        gate.wait(timeout=10.0)
+        return False, None
+
+    aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0), reference=blocked_reference, queue_max=2
+    )
+    from lodestar_tpu.offload import encode_sets
+
+    frame = encode_sets(_dummy_sets())
+    t0 = time.monotonic()
+    for _ in range(8):
+        aud.observe("ep", frame, 1, True, PriorityClass.GOSSIP_BLOCK)
+    # every observe returned immediately even with the worker wedged
+    assert time.monotonic() - t0 < 1.0
+    assert aud.dropped >= 5  # 1 in the worker + 2 queued, the rest shed
+    gate.set()
+    assert aud.drain()
+    aud.close()
+
+
+def test_auditor_queue_byte_cap_sheds_large_frames():
+    """The record-count cap alone would let 256 bulk frames pin tens of
+    MB behind a slow reference — the byte cap sheds first, and bytes
+    reserved by shed/drained records are released for later samples."""
+    gate = threading.Event()
+
+    def blocked_reference(sets, exclude_target):
+        gate.wait(timeout=10.0)
+        return False, None
+
+    from lodestar_tpu.offload import encode_sets
+
+    frame = encode_sets(_dummy_sets(4))  # 4 sets ≈ 708 bytes
+    aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0),
+        reference=blocked_reference,
+        queue_max=64,
+        queue_max_bytes=2 * len(frame),  # room for two frames, not three
+    )
+    accepted = [
+        aud.observe("ep", frame, 4, True, PriorityClass.GOSSIP_BLOCK)
+        for _ in range(8)
+    ]
+    # worker may have dequeued (releasing bytes) before later observes,
+    # but the cap bounds what is ever resident: never 3+ frames queued
+    assert aud._queue_bytes <= 2 * len(frame)
+    assert accepted.count(False) >= 5
+    assert aud.dropped >= 5
+    gate.set()
+    assert aud.drain()
+    aud.close()
+    assert aud._queue_bytes == 0  # every reservation was released
+
+
+def test_cross_helper_reference_arbitrates_lying_reference():
+    """Second-helper auditing: audited endpoint vs sibling disagree ->
+    the CPU arbiter decides which one lied; here the AUDITED endpoint's
+    verdict matches ground truth, so the SIBLING is the liar."""
+    server_a = BlsOffloadServer(lambda s: False, port=0)  # honest for these sets
+    server_b = BlsOffloadServer(lambda s: True, port=0)  # lies: True for garbage
+    server_a.start()
+    server_b.start()
+    A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+    aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0),
+        arbiter=lambda sets: False,  # ground truth: invalid
+        start=True,
+    )
+    client = BlsOffloadClient([A, B], probe_interval_s=3600.0, auditor=aud)
+    aud.set_reference(cross_helper_reference(client))
+    from lodestar_tpu.offload import encode_sets
+
+    frame = encode_sets(_dummy_sets())
+    try:
+        # audited endpoint A truthfully said False; sibling B will
+        # contradict with True; the arbiter sides with A -> B is the liar
+        assert aud.observe(A, frame, 1, False, PriorityClass.GOSSIP_BLOCK)
+        assert aud.drain()
+        assert len(aud.byzantine_events) == 1
+        assert aud.byzantine_events[0]["endpoint"] == B
+        assert aud.trust_value(B) < 1.0
+        assert aud.trust_value(A) == 1.0  # honest party credited
+        states = {s["target"]: s for s in client.endpoint_states()}
+        assert states[B]["quarantined"] and not states[A]["quarantined"]
+    finally:
+        asyncio.run(client.close())
+        server_a.stop()
+        server_b.stop()
+
+
+# -- the acceptance invariant -------------------------------------------------
+
+
+def test_lying_helper_detected_within_bound_quarantined_and_routed_around():
+    """`lie_verdict` on one of two endpoints: every protocol check
+    passes (the lie is re-signed), the node believes garbage sets are
+    valid — until the seeded audit samples one. Detection must land
+    within ceil(ln .01/ln(1-r)) of the liar's verdicts, quarantine the
+    endpoint (probe-immune), dump forensics with both verdicts, and
+    subsequent traffic must route to the honest sibling. The audit
+    never blocks the verify path (span + thread assertions)."""
+    server_a = BlsOffloadServer(lambda s: False, port=0)  # the lied-about backend
+    server_b = BlsOffloadServer(lambda s: False, port=0)  # honest sibling
+    server_a.start()
+    server_b.start()
+    A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+    inj = FaultInjector(
+        [FaultRule(FaultKind.LIE_VERDICT, targets=frozenset({A}), methods=frozenset({"verify"}))]
+    )
+    import tempfile
+
+    dump_dir = tempfile.mkdtemp(prefix="byz_audit_")
+    aud = OffloadAuditor(
+        sampler=AuditSampler(_RATE, seed=0),
+        reference=_stub_reference(False),  # trusted oracle: these sets are invalid
+        dump_dir=dump_dir,
+        quarantine_cooloff_s=None,  # until unquarantine
+    )
+    # A first: occupancy ties break toward the first endpoint, so the
+    # liar deterministically serves all pre-quarantine traffic
+    client = BlsOffloadClient(
+        [A, B], probe_interval_s=0.2, transport_wrapper=inj.wrap_transport, auditor=aud
+    )
+    tracer = tracing.configure(enabled=True, slow_slot_ms=60_000.0)
+    horizon = detection_horizon(_RATE)  # 7
+
+    async def drive():
+        lied = 0
+        caught_at = None
+        for i in range(horizon):
+            with tracing.root("block_import", slot=i):
+                v = await client.verify_signature_sets(_dummy_sets(), _GOSSIP)
+            if v:
+                lied += 1
+            aud.drain()
+            if client.endpoint_states()[0]["quarantined"]:
+                caught_at = i + 1
+                break
+        return lied, caught_at
+
+    try:
+        lied, caught_at = asyncio.run(drive())
+        # the lie WORKED until detection (this is the threat, not a bug)
+        assert lied >= 1 and lied == caught_at
+        assert caught_at is not None and caught_at <= horizon
+        states = {s["target"]: s for s in client.endpoint_states()}
+        assert states[A]["quarantined"] and states[A]["breaker"] == "open"
+        assert states[A]["trust"] < 1.0
+
+        # forensics dump: both verdicts, bound to the request
+        dumps = [f for f in os.listdir(dump_dir) if f.startswith("byzantine_")]
+        assert len(dumps) == 1
+        dump = json.load(open(os.path.join(dump_dir, dumps[0])))
+        assert dump["claimed_verdict"] is True and dump["recheck_verdict"] is False
+        assert dump["endpoint"] == A
+        assert dump["request_digest"] and dump["signature_sets"]
+        assert dump["class"] == "gossip_block"
+
+        # quarantine persisted for restart re-application
+        assert A in aud.load_quarantined()
+
+        # quarantine survives probe recoveries: the probe loop keeps
+        # answering for A (transport healthy!), yet the breaker stays out
+        time.sleep(0.5)
+        assert client.endpoint_states()[0]["quarantined"]
+
+        # re-route: the next verify lands on the honest sibling and the
+        # garbage is correctly rejected
+        async def after():
+            v = await client.verify_signature_sets(_dummy_sets(), _GOSSIP)
+            return v
+
+        assert asyncio.run(after()) is False
+        assert inj.calls_to(B, "verify") >= 1
+
+        # the audit never ran on the hot path: re-verification only on
+        # the audit thread, and no audit work inside the import traces
+        assert aud.audit_thread_names == {"offload-audit"}
+        imports = [t for t in tracer.ring if t.root and t.root.name == "block_import"]
+        assert imports, "block_import traces should have been recorded"
+        for t in imports:
+            names = {s.name for s in t.spans}
+            assert "offload_rpc" in names
+            assert not any("audit" in n for n in names)
+
+        # operator lift: one half-open trial re-earns CLOSED
+        assert client.unquarantine_endpoint(A)
+        assert A not in aud.load_quarantined()
+        assert not client.endpoint_states()[0]["quarantined"]
+    finally:
+        asyncio.run(client.close())
+        tracing.reset()
+        server_a.stop()
+        server_b.stop()
+
+
+def test_valid_block_imports_after_liar_quarantined(tmp_path):
+    """End-to-end acceptance: detection traffic is REAL tampered sets
+    (the CPU oracle proves the lie), and after quarantine a VALID signed
+    block imports through the degradation chain — served by the honest
+    offload sibling, never rejected."""
+    prev = params.active_preset()
+    params.set_active_preset("minimal")
+    try:
+        from lodestar_tpu.chain.chain import BeaconChain
+        from lodestar_tpu.db import MemoryDbController
+        from lodestar_tpu.state_transition.genesis import create_interop_genesis_state
+
+        from ..state_transition.test_state_transition import _empty_block_at
+
+        p = params.active_preset()
+        N = 16
+        sks = interop_secret_keys(N)
+        genesis = create_interop_genesis_state(N, p=p)
+
+        server_a = BlsOffloadServer(verify_signature_sets, port=0)
+        server_b = BlsOffloadServer(verify_signature_sets, port=0)
+        server_a.start()
+        server_b.start()
+        A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+        inj = FaultInjector(
+            [
+                FaultRule(
+                    FaultKind.LIE_VERDICT, targets=frozenset({A}), methods=frozenset({"verify"})
+                )
+            ]
+        )
+        metrics = create_metrics()
+        aud = OffloadAuditor(
+            sampler=AuditSampler(1.0, seed=0),  # audit every verdict: 1-shot detection
+            dump_dir=str(tmp_path),
+            metrics=metrics.audit,
+        )
+        client = BlsOffloadClient(
+            [A, B],
+            probe_interval_s=3600.0,
+            transport_wrapper=inj.wrap_transport,
+            metrics=metrics.resilience,
+            auditor=aud,
+        )
+        deg = DegradingBlsVerifier(
+            [("offload", client), ("cpu", BlsSingleThreadVerifier())],
+            metrics=metrics.resilience,
+        )
+        try:
+            # 1. the attack: tampered sets resolve True through the liar
+            async def attacked():
+                return await deg.verify_signature_sets(_tampered_sets(1), _GOSSIP)
+
+            assert asyncio.run(attacked()) is True  # the lie lands
+            assert aud.drain(timeout_s=30.0)
+            assert metrics.audit.byzantine.labels(A)._value.get() == 1
+            assert {s["target"]: s for s in client.endpoint_states()}[A]["quarantined"]
+
+            # 2. a valid block still imports — honest sibling serves
+            chain = BeaconChain(
+                anchor_state=genesis, bls_verifier=deg, db=MemoryDbController(), current_slot=1
+            )
+            signed = _empty_block_at(genesis, 1, sks, p)
+
+            async def import_valid():
+                await chain.process_block(signed)
+
+            asyncio.run(import_valid())
+            assert chain.get_head_state().slot == 1
+            assert deg.serving_layer() in (None, "offload")  # different task context
+            assert deg.last_layer == "offload"
+            assert inj.calls_to(B, "verify") >= 1
+        finally:
+            asyncio.run(deg.close())
+            server_a.stop()
+            server_b.stop()
+    finally:
+        params.set_active_preset(prev)
+
+
+def test_observe_never_blocks_even_with_slow_reference():
+    """Hot-path latency guard: a 300ms re-verification must cost the
+    verify caller ~nothing (the audit rides its own thread)."""
+
+    def slow_reference(sets, exclude_target):
+        time.sleep(0.3)
+        return False, None
+
+    server = BlsOffloadServer(lambda s: False, port=0)
+    server.start()
+    aud = OffloadAuditor(sampler=AuditSampler(1.0, seed=0), reference=slow_reference)
+    client = BlsOffloadClient(
+        f"127.0.0.1:{server.port}", probe_interval_s=3600.0, auditor=aud
+    )
+
+    async def timed():
+        t0 = time.monotonic()
+        v = await client.verify_signature_sets(_dummy_sets(), _GOSSIP)
+        return v, time.monotonic() - t0
+
+    try:
+        v, elapsed = asyncio.run(timed())
+        assert v is False
+        assert elapsed < 0.25, f"observe blocked the hot path: {elapsed:.3f}s"
+        assert aud.drain(timeout_s=5.0)
+        assert aud.audited == 1
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+
+# -- quarantine persistence ---------------------------------------------------
+
+
+def test_quarantine_persists_across_restart_and_unquarantine_clears(tmp_path):
+    aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0),
+        reference=_stub_reference(False),
+        dump_dir=str(tmp_path),
+        start=False,
+    )
+    aud._persist_quarantine("10.0.0.1:50051", "deadbeef")
+    aud.close()
+
+    # "restarted" auditor over the same dump dir sees the record
+    aud2 = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0), dump_dir=str(tmp_path), start=False
+    )
+    assert "10.0.0.1:50051" in aud2.load_quarantined()
+    aud2.clear_quarantine("10.0.0.1:50051")
+    assert aud2.load_quarantined() == {}
+    aud2.close()
+
+
+def test_remaining_cooloff_counts_time_served_across_restarts():
+    """A restart must not re-arm a full cool-off: time served before the
+    restart counts, an elapsed cool-off leaves the endpoint immediately
+    trial-eligible (minimal POSITIVE remainder — 0 would mean indefinite
+    to the breaker), and indefinite passes through as None."""
+    from lodestar_tpu.offload.audit import remaining_cooloff
+
+    now = 1_000_000.0
+    # quarantined 600s ago with a 900s cool-off: 300s left, not 900
+    assert remaining_cooloff({"at": now - 600}, 900.0, now) == pytest.approx(300.0)
+    # cool-off fully served before the restart: trial-eligible now
+    assert remaining_cooloff({"at": now - 2000}, 900.0, now) == 0.001
+    # indefinite (operator-lift-only) is preserved
+    assert remaining_cooloff({"at": now - 2000}, None, now) is None
+    # damaged record without a timestamp: full cool-off from now
+    assert remaining_cooloff({}, 900.0, now) == pytest.approx(900.0)
+
+
+def test_node_reapplies_persisted_quarantine(tmp_path):
+    """BeaconNodeOptions wiring: a restart re-quarantines a caught liar
+    unless --offload-unquarantine lifts it."""
+    server = BlsOffloadServer(lambda s: False, port=0)
+    server.start()
+    T = f"127.0.0.1:{server.port}"
+    seed_aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0), dump_dir=str(tmp_path), start=False
+    )
+    seed_aud._persist_quarantine(T, "deadbeef")
+    seed_aud.close()
+
+    aud = OffloadAuditor(sampler=AuditSampler(0.1, seed=0), dump_dir=str(tmp_path))
+    client = BlsOffloadClient(T, probe_interval_s=3600.0, auditor=aud)
+    try:
+        # the node init sequence: lift operator-cleared targets, then
+        # re-apply what's persisted
+        for target in aud.load_quarantined():
+            client.quarantine_endpoint(target, reason="persisted_byzantine")
+        assert client.endpoint_states()[0]["quarantined"]
+        assert client.is_down()  # sole endpoint out -> degradation chain
+        client.unquarantine_endpoint(T)
+        assert not client.endpoint_states()[0]["quarantined"]
+        assert aud.load_quarantined() == {}
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+
+# -- trust-aware routing ------------------------------------------------------
+
+
+def test_low_trust_endpoint_demoted_in_routing():
+    server_a = BlsOffloadServer(lambda s: False, port=0)
+    server_b = BlsOffloadServer(lambda s: False, port=0)
+    server_a.start()
+    server_b.start()
+    A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+    inj = FaultInjector()  # no rules: pure call accounting
+    aud = OffloadAuditor(sampler=AuditSampler(0.0, seed=0), start=False)
+    client = BlsOffloadClient(
+        [A, B], probe_interval_s=3600.0, transport_wrapper=inj.wrap_transport, auditor=aud
+    )
+    try:
+        # A would win the occupancy tie; tank its trust below threshold
+        ts = aud.trust_for(A)
+        for _ in range(4):
+            ts.record(False)
+        assert aud.trust_value(A) < 0.5
+
+        async def go():
+            for _ in range(3):
+                assert await client.verify_signature_sets(_dummy_sets(), _GOSSIP) is False
+
+        asyncio.run(go())
+        # every verify bypassed the demoted endpoint for the trusted one
+        assert inj.calls_to(B, "verify") == 3
+        assert inj.calls_to(A, "verify") == 0
+        states = {s["target"]: s for s in client.endpoint_states()}
+        assert states[A]["trust"] < 0.5 and states[B]["trust"] == 1.0
+        assert not states[A]["quarantined"]  # demoted, not quarantined
+    finally:
+        asyncio.run(client.close())
+        aud.close()
+        server_a.stop()
+        server_b.stop()
+
+
+def test_quarantine_gauge_and_persistence_converge_after_cooloff_self_heal(tmp_path):
+    """The cool-off expires LAZILY (the next trial clears the breaker
+    flag with no client code running): the probe loop must converge the
+    `lodestar_offload_audit_quarantined` gauge back to 0 AND drop the
+    persisted record — otherwise operators see a healed endpoint
+    reported quarantined forever and every restart re-imposes a
+    quarantine the cool-off contract already resolved."""
+    server = BlsOffloadServer(lambda s: False, port=0)
+    server.start()
+    T = f"127.0.0.1:{server.port}"
+    metrics = create_metrics()
+    aud = OffloadAuditor(
+        sampler=AuditSampler(0.0, seed=0),
+        reference=_stub_reference(False),  # False verdicts are always audited
+        metrics=metrics.audit,
+        dump_dir=str(tmp_path),
+    )
+    aud._persist_quarantine(T, "deadbeef")  # as a Byzantine event would
+    client = BlsOffloadClient(T, probe_interval_s=0.1, auditor=aud)
+    try:
+        client.quarantine_endpoint(T, cooloff_s=0.2, reason="test")
+        assert metrics.audit.quarantined.labels(T)._value.get() == 1
+        # the record survives while quarantined (a restart re-applies it)
+        time.sleep(0.15)
+        assert T in aud.load_quarantined()
+        time.sleep(0.15)  # cool-off elapses; no trial has run yet
+
+        async def trial():
+            # the half-open trial re-earns CLOSED and clears the flag
+            return await client.verify_signature_sets(_dummy_sets(), _GOSSIP)
+
+        assert asyncio.run(trial()) is False
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if (
+                metrics.audit.quarantined.labels(T)._value.get() == 0
+                and T not in aud.load_quarantined()
+            ):
+                break
+            time.sleep(0.05)
+        assert metrics.audit.quarantined.labels(T)._value.get() == 0
+        assert T not in aud.load_quarantined()  # rehabilitated on disk too
+        assert client.endpoint_states()[0]["breaker"] == "closed"
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+
+def test_persisted_quarantine_applies_even_with_auditing_disabled(tmp_path):
+    """--offload-audit-rate 0 turns off SAMPLING, not the standing
+    verdict: a persisted Byzantine quarantine re-applies at startup from
+    the module-level file helpers, no auditor required."""
+    from lodestar_tpu.offload.audit import clear_quarantine_file, load_quarantine_file
+
+    aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0), dump_dir=str(tmp_path), start=False
+    )
+    aud._persist_quarantine("10.0.0.9:50051", "deadbeef")
+    aud.close()
+
+    # the node's rate-0 path: read the file directly
+    persisted = load_quarantine_file(str(tmp_path))
+    assert "10.0.0.9:50051" in persisted
+    # and the rate-0 admin lift
+    clear_quarantine_file(str(tmp_path), "10.0.0.9:50051")
+    assert load_quarantine_file(str(tmp_path)) == {}
+
+
+def test_quarantine_file_damage_is_loud_even_when_json_parses(tmp_path, caplog):
+    """quarantine.json replaced with valid-JSON-but-not-an-object content
+    must hit the same LOUD branch as a parse error — silently returning
+    {} would re-trust a caught liar with zero warnings."""
+    import logging
+
+    from lodestar_tpu.offload.audit import load_quarantine_file
+
+    (tmp_path / "quarantine.json").write_text("[]\n")
+    with caplog.at_level(logging.ERROR, logger="lodestar.offload.audit"):
+        assert load_quarantine_file(str(tmp_path)) == {}
+    assert any("quarantine file unreadable" in r.message for r in caplog.records)
+
+
+def test_persist_quarantine_preserves_damaged_file(tmp_path):
+    """A new Byzantine record must never clobber a damaged quarantine.json
+    the operator was told to inspect — it is moved aside (evidence, maybe
+    recoverable records) before the fresh record is written."""
+    (tmp_path / "quarantine.json").write_text("{ not json")
+    aud = OffloadAuditor(
+        sampler=AuditSampler(1.0, seed=0), dump_dir=str(tmp_path), start=False
+    )
+    aud._persist_quarantine("liar:9000", "deadbeef")
+    aud.close()
+    from lodestar_tpu.offload.audit import load_quarantine_file
+
+    assert "liar:9000" in load_quarantine_file(str(tmp_path))
+    saved = [p for p in os.listdir(tmp_path) if p.startswith("quarantine.json.damaged-")]
+    assert len(saved) == 1
+    assert (tmp_path / saved[0]).read_text() == "{ not json"
+
+
+def test_false_verdicts_always_audited_regardless_of_rate():
+    """A False verdict rejects a block and downscores its sender on the
+    spot — it is audited at rate 1.0 whatever the sampler says, so a
+    helper lying False about valid blocks is caught on its FIRST lie,
+    not after ~1/rate honest peers were shed."""
+    aud = OffloadAuditor(
+        sampler=AuditSampler(0.0, seed=0),  # sampler never picks anything
+        reference=_stub_reference(True),  # oracle: these sets are VALID
+    )
+    from lodestar_tpu.offload import encode_sets
+
+    frame = encode_sets(_dummy_sets())
+    # a True verdict at rate 0: never sampled
+    assert not aud.observe("ep", frame, 1, True, PriorityClass.GOSSIP_BLOCK)
+    # a False verdict: always audited — and here it contradicts the
+    # oracle, so the False-lying helper is a Byzantine event immediately
+    assert aud.observe("ep", frame, 1, False, PriorityClass.BACKFILL)
+    assert aud.drain()
+    assert aud.audited == 1
+    assert len(aud.byzantine_events) == 1
+    assert aud.byzantine_events[0]["claimed_verdict"] is False
+    aud.close()

@@ -1,12 +1,22 @@
-"""Long-running chaos soak (marked slow, excluded from tier-1): a
-seeded probabilistic storm of every fault class against a two-endpoint
-offload deployment fronted by the degradation chain. Invariants:
+"""Chaos soaks: seeded probabilistic storms against a two-endpoint
+offload deployment fronted by the degradation chain.
+
+The original transport/corruption soak (slow-marked) proves:
 
 * no iteration EVER resolves True while the backends deem sets invalid
 * the degradation chain keeps availability: every iteration that does
   not error fail-closed still produces a (False) verdict
 * after heal(), the system recovers — offload serves again and the
   breakers re-close
+
+The LYING-helper storms add the Byzantine dimension: with
+`lie_verdict` in the storm the soundness invariant necessarily bends —
+a re-signed lie passes every protocol check — so the invariant becomes
+*bounded exposure*: every True verdict happens before the audit
+quarantines the liar, and after quarantine soundness is restored. The
+fast variant runs in tier-1; the long variant is slow-marked. Both are
+seeded end-to-end (fault schedule AND audit sampler), so a failure
+replays exactly.
 """
 
 from __future__ import annotations
@@ -18,12 +28,11 @@ import pytest
 from lodestar_tpu.chain.bls import BlsSingleThreadVerifier, DegradingBlsVerifier
 from lodestar_tpu.chain.bls.interface import IBlsVerifier, VerifySignatureOpts
 from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.offload.audit import AuditSampler, OffloadAuditor, detection_horizon
 from lodestar_tpu.offload.client import BlsOffloadClient
 from lodestar_tpu.offload.server import BlsOffloadServer
 from lodestar_tpu.scheduler import PriorityClass
 from lodestar_tpu.testing import FaultInjector, FaultKind, FaultRule
-
-pytestmark = pytest.mark.slow
 
 SOAK_ITERATIONS = 300
 SEED = 20260803
@@ -72,6 +81,7 @@ _PRIORITIES = [
 ]
 
 
+@pytest.mark.slow
 def test_chaos_soak_invariant_and_recovery():
     server_a = BlsOffloadServer(lambda s: False, port=0)
     server_b = BlsOffloadServer(lambda s: False, port=0)
@@ -182,3 +192,135 @@ def test_chaos_soak_invariant_and_recovery():
         asyncio.run(deg.close())
         server_a.stop()
         server_b.stop()
+
+
+# -- lying-helper storms (Byzantine dimension) --------------------------------
+
+
+def _lying_storm(iterations: int, lie_probability: float, audit_rate: float, seed: int):
+    """One seeded lying-helper storm: endpoint A lies (re-signed
+    verdicts) with `lie_probability`, the auditor samples at
+    `audit_rate` against an always-False oracle. Returns the exposure
+    record for the invariant assertions. Deterministic: verifies run
+    serially, so the fault schedule and the audit pick stream are both
+    pure functions of the seeds."""
+    server_a = BlsOffloadServer(lambda s: False, port=0)
+    server_b = BlsOffloadServer(lambda s: False, port=0)
+    server_a.start()
+    server_b.start()
+    A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+    inj = FaultInjector(
+        [
+            FaultRule(
+                FaultKind.LIE_VERDICT,
+                probability=lie_probability,
+                targets=frozenset({A}),
+                methods=frozenset({"verify"}),
+            )
+        ],
+        seed=seed,
+    )
+    aud = OffloadAuditor(
+        sampler=AuditSampler(audit_rate, seed=seed),
+        reference=lambda sets, exclude: (False, None),  # oracle: invalid
+        quarantine_cooloff_s=None,
+    )
+    client = BlsOffloadClient(
+        [A, B],
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+        auditor=aud,
+    )
+    deg = DegradingBlsVerifier([("offload", client), ("cpu", _AlwaysFalseCpu())])
+    lies_before_quarantine = 0
+    lies_after_quarantine = 0
+    quarantined_at = None
+    opts = VerifySignatureOpts(priority=int(PriorityClass.GOSSIP_BLOCK))
+
+    async def storm():
+        nonlocal lies_before_quarantine, lies_after_quarantine, quarantined_at
+        for i in range(iterations):
+            v = await deg.verify_signature_sets(_dummy_sets(), opts)
+            # every audit for verdict i is drained before verdict i+1,
+            # so "quarantined" is well-ordered against the lie count
+            aud.drain(timeout_s=5.0)
+            q = {s["target"]: s["quarantined"] for s in client.endpoint_states()}
+            if v is True:
+                if q[A] and quarantined_at is not None:
+                    lies_after_quarantine += 1
+                else:
+                    lies_before_quarantine += 1
+            if q[A] and quarantined_at is None:
+                quarantined_at = i + 1
+
+    try:
+        asyncio.run(storm())
+        return {
+            "injected_lies": inj.injected[FaultKind.LIE_VERDICT],
+            "lies_before": lies_before_quarantine,
+            "lies_after": lies_after_quarantine,
+            "quarantined_at": quarantined_at,
+            "byzantine_events": list(aud.byzantine_events),
+            "calls_to_b": inj.calls_to(B, "verify"),
+            "sampled": aud.sampled,
+            "audited": aud.audited,
+        }
+    finally:
+        asyncio.run(deg.close())
+        server_a.stop()
+        server_b.stop()
+
+
+def _assert_lying_storm_invariants(res, iterations: int, lie_p: float, rate: float):
+    # the storm actually stormed, and the attack actually landed first
+    assert res["injected_lies"] >= 1
+    assert res["lies_before"] >= 1
+    # bounded exposure: once quarantined, the liar NEVER serves again
+    assert res["quarantined_at"] is not None, f"liar never caught: {res}"
+    assert res["lies_after"] == 0
+    assert res["byzantine_events"], res
+    # detection inside the 2G2T bound on AUDITED lying verdicts: the
+    # effective per-verdict catch probability is lie_p * rate
+    assert res["quarantined_at"] <= detection_horizon(lie_p * rate)
+    # post-quarantine the honest sibling carried the traffic
+    assert res["calls_to_b"] >= iterations - res["quarantined_at"]
+    assert res["audited"] == res["sampled"]  # nothing dropped at this pace
+
+
+def test_lying_helper_storm_fast():
+    """Tier-1 variant: probabilistic lies + aggressive audit, seeded —
+    exposure is bounded by the sampling math and replays exactly."""
+    lie_p, rate = 0.5, 0.5
+    res = _lying_storm(iterations=60, lie_probability=lie_p, audit_rate=rate, seed=SEED)
+    _assert_lying_storm_invariants(res, 60, lie_p, rate)
+    # determinism: same seeds => byte-identical storm outcome
+    res2 = _lying_storm(iterations=60, lie_probability=lie_p, audit_rate=rate, seed=SEED)
+    assert (
+        res2["quarantined_at"],
+        res2["lies_before"],
+        res2["injected_lies"],
+        res2["sampled"],
+    ) == (
+        res["quarantined_at"],
+        res["lies_before"],
+        res["injected_lies"],
+        res["sampled"],
+    )
+
+
+@pytest.mark.slow
+def test_lying_helper_storm_long():
+    """Slow variant: a rare liar (10%) under a realistic audit rate —
+    the long-con that makes sampling (not per-verdict checking) the
+    right defense. Detection may legitimately take hundreds of verdicts;
+    the bound still holds."""
+    lie_p, rate = 0.1, 0.25
+    res = _lying_storm(
+        iterations=detection_horizon(lie_p * rate) + 50,
+        lie_probability=lie_p,
+        audit_rate=rate,
+        seed=SEED + 1,
+    )
+    _assert_lying_storm_invariants(
+        res, detection_horizon(lie_p * rate) + 50, lie_p, rate
+    )

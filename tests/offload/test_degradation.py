@@ -297,3 +297,66 @@ def test_block_imports_through_degradation_chain_with_offload_partitioned(minima
         asyncio.run(deg.close())
         server_a.stop()
         server_b.stop()
+
+
+# -- per-call serving-layer attribution (last_layer race fix) -----------------
+
+
+def test_concurrent_imports_read_their_own_serving_layer():
+    """Two concurrent verifies, one degraded and one served by the
+    primary: `last_layer` (shared slot) is whatever finished LAST, but
+    `serving_layer()` is a contextvar — each task reads the layer that
+    served ITS verdict, so the `verifier_layer` span attribute can't be
+    mis-attributed across interleaved imports."""
+
+    class _SelectiveSlow(IBlsVerifier):
+        """Primary: errs for sets tagged 0xAA; serves others."""
+
+        async def verify_signature_sets(self, sets, opts=None) -> bool:
+            if bytes(sets[0].message)[0] == 0xAA:
+                raise RuntimeError("primary refuses the tagged set")
+            await asyncio.sleep(0.01)
+            return True
+
+        def can_accept_work(self) -> bool:
+            return True
+
+        async def close(self) -> None:
+            return None
+
+    class _SlowCpu(IBlsVerifier):
+        """Fallback: slow enough that the degraded task finishes AFTER
+        the primary-served one overwrote last_layer."""
+
+        async def verify_signature_sets(self, sets, opts=None) -> bool:
+            await asyncio.sleep(0.1)
+            return False
+
+        def can_accept_work(self) -> bool:
+            return True
+
+        async def close(self) -> None:
+            return None
+
+    deg = DegradingBlsVerifier([("offload", _SelectiveSlow()), ("cpu", _SlowCpu())])
+
+    def tagged(b: int):
+        return [SignatureSet(pubkey=bytes(48), message=bytes([b]) * 32, signature=bytes(96))]
+
+    async def degraded_task():
+        v = await deg.verify_signature_sets(tagged(0xAA))
+        return v, deg.serving_layer()
+
+    async def primary_task():
+        v = await deg.verify_signature_sets(tagged(0x01))
+        return v, deg.serving_layer()
+
+    async def go():
+        (dv, dl), (pv, pl) = await asyncio.gather(degraded_task(), primary_task())
+        assert (dv, dl) == (False, "cpu")
+        assert (pv, pl) == (True, "offload")
+        # the shared slot was last written by the slower (degraded) task
+        # — exactly the mis-attribution serving_layer() avoids
+        assert deg.last_layer == "cpu"
+
+    asyncio.run(go())

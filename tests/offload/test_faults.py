@@ -236,3 +236,48 @@ def test_chaos_invariant_holds_through_server_backend_faults():
     finally:
         asyncio.run(client.close())
         server.stop()
+
+
+def test_lie_verdict_is_protocol_indistinguishable_from_honest():
+    """The byzantine fault class: `_lie_verdict` flips the verdict AND
+    recomputes the digest, so — unlike FLIP_VERDICT — the frame passes
+    strict decoding. That indistinguishability is the point: framing
+    cannot catch a helper that signs its lie, only independent
+    re-verification (offload/audit.py) can."""
+    from lodestar_tpu.testing.faults import _flip_verdict_byte, _lie_verdict
+
+    req = encode_sets(_sets(2))
+    honest = encode_verdict(False, request=req)
+
+    flipped = _flip_verdict_byte(honest)
+    with pytest.raises(OffloadError, match="digest mismatch"):
+        decode_verdict(flipped, request=req)  # framing catches the flip
+
+    lied = _lie_verdict(honest, req)
+    assert decode_verdict(lied, request=req, require_digest=True) is True  # it lands
+    assert lied == encode_verdict(True, request=req)  # byte-identical to honest-True
+    # legacy 1-byte frames lie too (nothing to re-sign)
+    assert _lie_verdict(b"\x00", req) == b"\x01"
+    # error frames pass through: an error already fails closed
+    err = encode_verdict(None, error="boom")
+    assert _lie_verdict(err, req) == err
+
+
+def test_lie_verdict_through_the_transport_seam():
+    """End-to-end: a LIE_VERDICT rule makes the client resolve True for
+    sets the backend rejected — no OffloadError, no breaker trip. The
+    client-side protocol stack is PROVABLY blind to this fault."""
+    server = BlsOffloadServer(lambda s: False, port=0)
+    server.start()
+    inj = FaultInjector([FaultRule(FaultKind.LIE_VERDICT, methods=frozenset({"verify"}))])
+    client = BlsOffloadClient(
+        f"127.0.0.1:{server.port}", probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+    )
+    try:
+        assert asyncio.run(client.verify_signature_sets(_sets(1))) is True
+        assert inj.injected[FaultKind.LIE_VERDICT] == 1
+        assert client.endpoint_states()[0]["breaker"] == "closed"
+    finally:
+        asyncio.run(client.close())
+        server.stop()

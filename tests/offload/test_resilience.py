@@ -414,3 +414,97 @@ def test_half_open_trial_recloses_breaker_after_recovery(two_servers):
         asyncio.run(go())
     finally:
         asyncio.run(client.close())
+
+
+# -- trial tokens (generation-matched outcomes) -------------------------------
+
+
+def test_stale_preopen_failure_does_not_reopen_mid_trial():
+    """A long RPC admitted while CLOSED resolves as a failure AFTER the
+    breaker opened and a half-open trial started: without tokens it
+    would re-open the breaker mid-trial and discard the trial's
+    success; with tokens the stale outcome is ignored."""
+    clock = _Clock()
+    b = _breaker(clock, failure_threshold=2)
+    stale = b.try_acquire()  # CLOSED-era token for the long RPC
+    assert stale
+    b.record_failure(stale)
+    b.record_failure(stale)  # threshold -> OPEN (same era)
+    assert b.state() is BreakerState.OPEN
+    clock.t += 10.0
+    trial = b.try_acquire()
+    assert trial and trial != stale
+    assert b.state() is BreakerState.HALF_OPEN
+    # the pre-open RPC's failure lands now: stale, ignored
+    b.record_failure(stale)
+    assert b.state() is BreakerState.HALF_OPEN
+    # the real trial outcome decides
+    b.record_success(trial)
+    assert b.state() is BreakerState.CLOSED
+
+
+def test_stale_preopen_success_does_not_close_open_breaker():
+    clock = _Clock()
+    b = _breaker(clock, failure_threshold=2)
+    stale = b.try_acquire()
+    b.record_failure(None)
+    b.record_failure(None)  # tokenless failures still open (legacy path)
+    assert b.state() is BreakerState.OPEN
+    clock.t += 100.0  # well past the reset delay
+    # without the token this would count as trial-equivalent and close;
+    # the stale token proves it predates the failures
+    b.record_success(stale)
+    assert b.state() is BreakerState.OPEN
+    # tokenless callers (the pool gates on is_open alone) keep the old
+    # trial-equivalent behavior past the window
+    b.record_success(None)
+    assert b.state() is BreakerState.CLOSED
+
+
+def test_tokenless_paths_keep_legacy_semantics():
+    clock = _Clock()
+    b = _breaker(clock, failure_threshold=3)
+    for _ in range(3):
+        b.record_failure()
+    assert b.state() is BreakerState.OPEN
+    clock.t += 100.0
+    b.record_failure()  # failure past delay re-arms (wedge-pool contract)
+    assert b.state() is BreakerState.OPEN
+    assert b.seconds_until_trial() > 0.0
+
+
+# -- quarantine ----------------------------------------------------------------
+
+
+def test_quarantine_survives_probe_release_until_cooloff():
+    clock = _Clock()
+    b = _breaker(clock)
+    b.quarantine(30.0)
+    assert b.state() is BreakerState.OPEN and b.is_quarantined and b.is_open
+    # a Status probe recovery is transport evidence, not honesty evidence
+    b.note_probe_success()
+    assert b.is_open and b.try_acquire() is None
+    # a stale success from an in-flight RPC cannot close it either
+    b.record_success(1)
+    assert b.state() is BreakerState.OPEN
+    # cool-off elapses: exactly one half-open trial re-earns trust
+    clock.t += 31.0
+    assert not b.is_quarantined
+    tok = b.try_acquire()
+    assert tok and b.state() is BreakerState.HALF_OPEN
+    b.record_success(tok)
+    assert b.state() is BreakerState.CLOSED
+
+
+def test_indefinite_quarantine_needs_unquarantine():
+    clock = _Clock()
+    b = _breaker(clock)
+    b.quarantine(None)
+    clock.t += 1e9
+    assert b.is_quarantined and b.try_acquire() is None
+    b.unquarantine()
+    assert not b.is_quarantined
+    tok = b.try_acquire()  # straight to the trial, not straight to CLOSED
+    assert tok and b.state() is BreakerState.HALF_OPEN
+    b.record_failure(tok)
+    assert b.state() is BreakerState.OPEN  # failed trial re-opens normally

@@ -191,3 +191,48 @@ def test_backfill_rejects_broken_linkage(blockchain):
     )
     with pytest.raises(BackfillError, match="linkage"):
         asyncio.run(bf.backfill(blocks[-1], until_slot=0, terminal_root=b"\x00" * 32))
+
+
+def test_range_sync_verifier_outage_pauses_without_downscoring(blockchain):
+    """A batch rejected because the LOCAL verifier stack is in outage
+    must neither downscore the serving peer nor burn the batch's
+    processing-attempt budget (terminally failing sync within seconds of
+    a transient incident) — the round pauses and the sync driver retries
+    once the verifier is back."""
+    from lodestar_tpu.chain.bls.interface import IBlsVerifier
+
+    class _OutageVerifier(IBlsVerifier):
+        async def verify_signature_sets(self, sets, opts=None):
+            raise RuntimeError("verifier stack down")
+
+        def in_outage(self):
+            return True
+
+        def can_accept_work(self):
+            return True
+
+        async def close(self):
+            return None
+
+    p, genesis, blocks = blockchain
+    chain = BeaconChain(
+        anchor_state=genesis,
+        bls_verifier=_OutageVerifier(),
+        db=MemoryDbController(),
+        current_slot=12,
+    )
+    net = ScriptedNetwork(blocks)
+    downscored = []
+    rs = RangeSync(
+        chain=chain, network=net, peers=["honest"],
+        on_peer_downscore=lambda peer, reason: downscored.append(peer),
+    )
+    res = asyncio.run(rs.sync(1, 12))
+    assert not res.completed
+    assert downscored == []  # honest peer spared
+    assert res.failed_batch is not None
+    # the attempt budget is untouched: the batch is retryable, not FAILED
+    assert res.failed_batch.processing_attempts == 0
+    from lodestar_tpu.sync import BatchStatus
+
+    assert res.failed_batch.status is BatchStatus.AWAITING_PROCESSING

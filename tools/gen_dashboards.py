@@ -280,6 +280,7 @@ def all_dashboards():
         ("lodestar_block_pipeline_trace.json", trace_dashboard()),
         ("lodestar_sched_occupancy.json", sched_dashboard()),
         ("lodestar_offload_resilience.json", resilience_dashboard()),
+        ("lodestar_offload_audit.json", audit_dashboard()),
     )
 
 
@@ -600,8 +601,14 @@ def resilience_dashboard():
             y=16, pid=5,
         ),
         panel(
-            "Admission sheds by reason",
-            [("sum by (reason) (rate(lodestar_resilience_shed_total[5m]))", "{{reason}}")],
+            "Admission sheds / outage-unscored rejections",
+            [
+                ("sum by (reason) (rate(lodestar_resilience_shed_total[5m]))", "shed {{reason}}"),
+                (
+                    "rate(lodestar_resilience_outage_unscored_total[5m])",
+                    "outage rejections (peer spared)",
+                ),
+            ],
             unit="ops", x=12, y=16, pid=6,
         ),
     ]
@@ -610,6 +617,78 @@ def resilience_dashboard():
         "Lodestar TPU - Offload resilience",
         ps,
         ["lodestar", "resilience"],
+    )
+
+
+def audit_dashboard():
+    """Byzantine offload auditing (offload/audit.py): sampling and
+    re-verification rates, per-endpoint trust EWMA, Byzantine events and
+    quarantine state, and the audit worker's CPU spend against its duty-
+    cycle budget. The "can I trust my offload helpers" dashboard.
+    (prometheus_client suffixes counters with _total — every counter
+    expr below carries it.)"""
+    ps = [
+        panel(
+            "Trust score by endpoint (EWMA, 1.0 = never contradicted)",
+            [("lodestar_offload_audit_trust_score", "{{endpoint}}")],
+            pid=1,
+        ),
+        panel(
+            "Quarantined endpoints / Byzantine events",
+            [
+                ("lodestar_offload_audit_quarantined", "quarantined {{endpoint}}"),
+                (
+                    "sum by (endpoint) (increase(lodestar_offload_audit_byzantine_total[1h]))",
+                    "byzantine {{endpoint}} (1h)",
+                ),
+            ],
+            x=12, pid=2,
+        ),
+        panel(
+            "Audit sampling rate by class",
+            [
+                (
+                    "sum by (class) (rate(lodestar_offload_audit_sampled_total[5m]))",
+                    "sampled {{class}}",
+                ),
+            ],
+            unit="ops", y=8, pid=3,
+        ),
+        panel(
+            "Re-verification outcomes",
+            [
+                (
+                    "sum by (outcome) (rate(lodestar_offload_audit_verified_total[5m]))",
+                    "{{outcome}}",
+                ),
+                (
+                    "sum by (reason) (rate(lodestar_offload_audit_dropped_total[5m]))",
+                    "dropped {{reason}}",
+                ),
+            ],
+            unit="ops", x=12, y=8, pid=4,
+        ),
+        panel(
+            "Audit queue backlog",
+            [("lodestar_offload_audit_queue_depth", "backlog")],
+            y=16, pid=5,
+        ),
+        panel(
+            "Audit CPU duty cycle (fraction of one core)",
+            [
+                (
+                    "rate(lodestar_offload_audit_cpu_seconds_total[5m])",
+                    "audit cpu s/s",
+                ),
+            ],
+            x=12, y=16, pid=6,
+        ),
+    ]
+    return dashboard(
+        "lodestar-offload-audit",
+        "Lodestar TPU - Offload Byzantine audit",
+        ps,
+        ["lodestar", "audit"],
     )
 
 
