@@ -60,8 +60,8 @@ class DegradingBlsVerifier(IBlsVerifier):
         if not layers:
             raise ValueError("at least one verifier layer required")
         self.layers = list(layers)
-        self.last_layer: str | None = None
-        self._outage = False
+        self.last_layer: str | None = None  # guarded by: advisory-only (shared slot; per-call truth is the serving_layer() contextvar)
+        self._outage = False  # guarded by: advisory-only (telemetry slot; scoring rides the per-rejection verifier_outage mark)
         self._metrics = metrics
         self._log = get_logger(name="lodestar.bls-degrade")
 

@@ -168,7 +168,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             reject_depth=MAX_JOBS_CAN_ACCEPT_WORK,
             can_accept=lambda: not self._closed,
         )
-        self._outstanding = 0
+        self._outstanding = 0  # guarded by: event-loop (writers; scrape-time depth_fn readers tolerate a stale int)
         if sched_metrics is not None:
             # scrape-time evaluation: the EWMA decays on read, so an idle
             # pool reports decaying occupancy instead of freezing at the
@@ -177,14 +177,14 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 lambda: self.occupancy.occupancy_permille()
             )
             sched_metrics.admission_state.set_function(lambda: int(self.admission.state()))
-        self._buffered: list[_Job] = []
-        self._buffered_sigs = 0
-        self._buffer_timer: asyncio.TimerHandle | None = None
-        self._closed = False
-        self._runner: asyncio.Task | None = None
+        self._buffered: list[_Job] = []  # guarded by: event-loop (single-threaded)
+        self._buffered_sigs = 0  # guarded by: event-loop (single-threaded)
+        self._buffer_timer: asyncio.TimerHandle | None = None  # guarded by: event-loop (single-threaded)
+        self._closed = False  # guarded by: event-loop (one-way flag; executor readers see it at worst one package late)
+        self._runner: asyncio.Task | None = None  # guarded by: event-loop (single-threaded)
 
         # metric counters (reference blsThreadPool.* taxonomy)
-        self.metrics = {
+        self.metrics = {  # guarded by: runner-serialized (one package in flight at a time; scrapers read stale-by-one)
             "jobs_started": 0,
             "sig_sets_started": 0,
             "batch_retries": 0,

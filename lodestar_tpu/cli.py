@@ -251,6 +251,7 @@ async def _run_lightclient(args) -> int:
     spec = client.get_spec()["data"]
     seconds_per_slot = int(spec.get("SECONDS_PER_SLOT", 12))
     while True:
+        # lint: allow(monotonic-durations) — slot math is anchored at the protocol's wall-clock genesis_time; monotonic has no epoch
         current_slot = max(0, int(_time.time()) - genesis_time) // max(1, seconds_per_slot)
         lc.sync_to_head(current_slot=current_slot)
         lc.poll_head()
@@ -338,6 +339,7 @@ async def _run_dev(args) -> int:
         if p2p and args.genesis_time:
             # wall-clock slot alignment so peers' clocks agree
             start = args.genesis_time + slot * cc.SECONDS_PER_SLOT
+            # lint: allow(monotonic-durations) — aligning to a shared wall-clock genesis_time so peers' slot clocks agree
             delay = start - _time.time()
             if delay > 0:
                 await asyncio.sleep(delay)
@@ -440,6 +442,7 @@ async def _run_beacon(args) -> int:
         client = BeaconApiClient(args.checkpoint_sync_url)
         genesis_time = int(client.get_genesis()["data"]["genesis_time"])
         current_slot = (
+            # lint: allow(monotonic-durations) — slot math is anchored at the protocol's wall-clock genesis_time
             max(0, int(_time.time()) - genesis_time) // chain_cfg.SECONDS_PER_SLOT
         )
         anchor = fetch_checkpoint_state(client, p=p, current_slot=current_slot)
@@ -687,6 +690,7 @@ async def _run_validator(args) -> int:
                 print(f"slot {slot}: duty error: {e}", file=sys.stderr)
             ran += 1
             next_slot_at = genesis_time + (slot + 1) * seconds
+            # lint: allow(monotonic-durations) — sleeping until a wall-clock slot boundary derived from genesis_time
             await asyncio.sleep(max(0.2, next_slot_at - _time.time()))
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass

@@ -394,28 +394,28 @@ class OffloadAuditor:
         self._metrics = metrics  # AuditMetrics (metrics/__init__.py) or stub
         self._queue: queue.Queue[AuditRecord] = queue.Queue(maxsize=queue_max)
         self._queue_max_bytes = max(1, queue_max_bytes)
-        self._queue_bytes = 0  # retained frame bytes, guarded by _lock
+        self._queue_bytes = 0  # guarded by: _lock — retained frame bytes
         self._lock = threading.Lock()
-        self.trust: dict[str, TrustScore] = {}
+        self.trust: dict[str, TrustScore] = {}  # guarded by: _lock
         self.log = get_logger(name="lodestar.offload.audit")
         # quarantine_cb(target, cooloff_s, reason) — bound by the client
         self._quarantine_cb = None
-        self._closed = False
-        self.sampled = 0
-        self.audited = 0
-        self.dropped = 0
-        self._processed = 0  # records fully handled by the worker (drain())
+        self._closed = False  # guarded by: close-then-join (one-way flag; racy reads shed at worst one sample)
+        self.sampled = 0  # guarded by: _lock
+        self.audited = 0  # guarded by: _lock
+        self.dropped = 0  # guarded by: _lock
+        self._processed = 0  # guarded by: _lock — records fully handled by the worker (drain())
         # persisted-quarantine targets (lazy cache over quarantine.json):
         # lets note_rehabilitated() be a set-lookup no-op per probe tick
-        self._persisted_targets: set[str] | None = None
+        self._persisted_targets: set[str] | None = None  # guarded by: _fs_lock
         self._fs_lock = threading.Lock()  # quarantine.json read-modify-write
         self._stop = threading.Event()  # close() interrupts budget idle waits
         # recent events only (ring): the dump files are the durable
         # forensics — a flaky-Byzantine helper cycling quarantine→rehab
         # must not leak memory in a list nothing in production reads
-        self.byzantine_events: deque[dict] = deque(maxlen=64)
-        self.audit_thread_names: set[str] = set()
-        self._dump_seq = 0
+        self.byzantine_events: deque[dict] = deque(maxlen=64)  # guarded by: audit-thread (single writer; deque append is GIL-atomic)
+        self.audit_thread_names: set[str] = set()  # guarded by: audit-thread (single writer; tests read after drain())
+        self._dump_seq = 0  # guarded by: _lock
         self._thread = threading.Thread(
             target=self._drain_loop, name="offload-audit", daemon=True
         )

@@ -129,23 +129,23 @@ class _Endpoint:
         self.channel = None
         self.verify = None
         self.status = None
-        self.healthy = True  # optimistic until the first probe
-        self.consecutive_failures = 0
-        self.outstanding = 0
-        self.occupancy_permille: int | None = None
-        self.queue_depth: int | None = None
-        self.admission = AdmissionState.ACCEPT
-        self.extended = False
+        self.healthy = True  # guarded by: _lock [shared] — optimistic until the first probe
+        self.consecutive_failures = 0  # guarded by: probe-thread (single owner)
+        self.outstanding = 0  # guarded by: _lock [shared]
+        self.occupancy_permille: int | None = None  # guarded by: _lock [shared]
+        self.queue_depth: int | None = None  # guarded by: _lock [shared]
+        self.admission = AdmissionState.ACCEPT  # guarded by: _lock [shared]
+        self.extended = False  # guarded by: _lock [shared]
         self.breaker = breaker
         # sticky: once this server has spoken the digest-checked verdict
         # format, a bare legacy frame is a truncation/downgrade, not compat
-        self.digest_seen = False
+        self.digest_seen = False  # guarded by: _lock [shared]
         # set when THIS session quarantined the endpoint: gates the
         # rehabilitation cleanup so a fresh CLOSED endpoint at startup
         # can't wipe a persisted record before the node re-applies it
-        self.was_quarantined = False
+        self.was_quarantined = False  # guarded by: _lock [shared]
 
-    def state(self) -> dict:
+    def state(self) -> dict:  # lint: allow(lock-discipline) — sole caller is endpoint_states(), which holds the owning client's _lock
         return {
             "target": self.target,
             "healthy": self.healthy,
@@ -158,7 +158,7 @@ class _Endpoint:
         }
 
 
-def _occupancy_key(ep: _Endpoint) -> tuple[int, int]:
+def _occupancy_key(ep: _Endpoint) -> tuple[int, int]:  # lint: allow(lock-discipline) — sort key for _pick_endpoint, which holds the client's _lock
     return (
         ep.occupancy_permille if ep.occupancy_permille is not None else _UNKNOWN_OCCUPANCY,
         ep.outstanding,
@@ -209,8 +209,8 @@ class BlsOffloadClient(IBlsVerifier):
         self._class_deadlines = dict(class_deadlines or CLASS_DEADLINE_S)
         self._hedge_classes = HEDGE_CLASSES if hedge_classes is None else hedge_classes
         self._lock = threading.Lock()
-        self._outstanding = 0
-        self._closed = False
+        self._outstanding = 0  # guarded by: _lock
+        self._closed = False  # guarded by: close-only (one-way flag; stale readers make one last doomed RPC)
         self._wake = threading.Event()  # close() wakes the probe thread
         self._endpoints = []
         for t in targets:
@@ -359,8 +359,17 @@ class BlsOffloadClient(IBlsVerifier):
                     # never tear down a channel with verifications in
                     # flight: a transient probe timeout must not abort
                     # valid work — in-flight RPCs fail (or succeed) on
-                    # their own merits
-                    if ep.outstanding == 0:
+                    # their own merits. The lock covers the read only:
+                    # a check-then-act window remains in which the hot
+                    # path admits an RPC onto the channel _reconnect is
+                    # about to close. That RPC fails into the breaker /
+                    # hedge / degradation machinery rather than
+                    # silently, and the window only exists for an
+                    # endpoint that just failed a probe, which routing
+                    # already deprioritizes.
+                    with self._lock:
+                        idle = ep.outstanding == 0
+                    if idle:
                         self._reconnect(ep)
                     next_at[i] = time.monotonic() + RECONNECT_BACKOFF_S[idx]
             if self._closed:
@@ -650,6 +659,7 @@ class BlsOffloadClient(IBlsVerifier):
             # may raise OffloadError: server error frame, malformed frame,
             # or a digest that doesn't bind this request to this verdict —
             # trailing spans still came home and must be grafted below
+            # lint: allow(lock-discipline) — executor-thread read of a one-way sticky flag: a stale False only re-admits legacy framing for an RPC already in flight
             verdict = decode_verdict(resp, request=frame, require_digest=ep.digest_seen)
             ep.breaker.record_success(token)
             with self._lock:
@@ -716,6 +726,7 @@ class BlsOffloadClient(IBlsVerifier):
         gossip verify onto a slower fallback layer)."""
         if self._closed:
             return True
+        # lint: allow(lock-discipline) — lock-free hot-path read; a stale healthy bit costs one misrouted admission check, never a verdict
         return not any(ep.healthy and not ep.breaker.is_open for ep in self._endpoints)
 
     def can_accept_work(self) -> bool:
@@ -724,6 +735,7 @@ class BlsOffloadClient(IBlsVerifier):
         Sheds load rather than queueing against dead or saturated
         services. The cap is per endpoint (reference MAX_JOBS per pool),
         so adding offload servers adds admitted concurrency."""
+        # lint: allow(lock-discipline) — lock-free hot-path read (GIL-atomic int); a torn-by-one count moves admission by one job
         if self._outstanding >= self.max_outstanding * len(self._endpoints):
             return False
         return not self.is_down()

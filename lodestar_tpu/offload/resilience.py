@@ -159,18 +159,18 @@ class CircuitBreaker:
         self._clock = clock
         self._on_transition = on_transition
         self._lock = threading.Lock()
-        self._state = BreakerState.CLOSED
-        self._failures = 0  # consecutive, resets on success
-        self._open_streak = 0  # consecutive opens -> exponential reset delay
-        self._retry_at = 0.0
-        self._trial_inflight = False
+        self._state = BreakerState.CLOSED  # guarded by: _lock
+        self._failures = 0  # guarded by: _lock — consecutive, resets on success
+        self._open_streak = 0  # guarded by: _lock — consecutive opens -> exponential reset delay
+        self._retry_at = 0.0  # guarded by: _lock
+        self._trial_inflight = False  # guarded by: _lock
         # generation token: bumped on EVERY state transition, handed out
         # by try_acquire; outcomes carrying a stale token are ignored
-        self._epoch = 1
+        self._epoch = 1  # guarded by: _lock
         # Byzantine quarantine (offload/audit.py): forced-open with a
         # flag probe recoveries don't release; _retry_at holds the
         # cool-off deadline (inf = until unquarantine())
-        self._quarantined = False
+        self._quarantined = False  # guarded by: _lock
 
     # -- queries ---------------------------------------------------------------
 

@@ -67,13 +67,13 @@ class BlsOffloadServer:
         self.backend = backend
         self._can_accept_work = can_accept_work or (lambda: True)
         self.occupancy = occupancy_tracker or OccupancyTracker()
-        self._pending = 0
+        self._pending = 0  # guarded by: _pending_lock
         self._pending_lock = threading.Lock()
         self.admission = admission or AdmissionController(
             self.occupancy,
             shed_bulk_at=shed_bulk_at,
             reject_at=reject_at,
-            depth_fn=lambda: self._pending,
+            depth_fn=self._depth,
             # _pending counts RPCs already ON the gRPC worker threads —
             # the executor queues the rest invisibly, so it never exceeds
             # max_workers. All-workers-busy is therefore the depth signal
@@ -98,6 +98,12 @@ class BlsOffloadServer:
         )
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host
+
+    def _depth(self) -> int:
+        """In-flight RPC count for admission/status — locked, so the
+        grader never folds a torn read into its thresholds."""
+        with self._pending_lock:
+            return self._pending
 
     # -- handlers --------------------------------------------------------------
 
@@ -141,7 +147,7 @@ class BlsOffloadServer:
     def _status(self, request: bytes, context) -> bytes:
         return encode_status(
             occupancy_permille=self.occupancy.occupancy_permille(),
-            queue_depth=self._pending,
+            queue_depth=self._depth(),
             admission=self.admission.state(),
         )
 

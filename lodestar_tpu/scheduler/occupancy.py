@@ -48,12 +48,12 @@ class OccupancyTracker:
         self._tau_ns = tau_s * 1e9
         self._time_fn = time_fn
         self._lock = threading.Lock()
-        self._active = 0
-        self._ewma = 0.0
-        self._last_ns = time_fn()
-        self.busy_ns_total = 0  # lifetime busy integral (debug/tests)
+        self._active = 0  # guarded by: _lock
+        self._ewma = 0.0  # guarded by: _lock
+        self._last_ns = time_fn()  # guarded by: _lock
+        self.busy_ns_total = 0  # guarded by: _lock — lifetime busy integral (debug/tests)
 
-    def _advance(self, now_ns: int) -> None:
+    def _advance(self, now_ns: int) -> None:  # lint: allow(lock-discipline) — internal fold step; every caller (begin/end/occupancy) holds _lock
         dt = now_ns - self._last_ns
         if dt <= 0:
             return

@@ -1,0 +1,35 @@
+"""Fixture: blocking waits inside a held lock — must flag."""
+
+import time
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = None
+        self._event = threading.Event()
+
+    def nap_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)  # BAD
+
+    def wait_under_lock(self):
+        with self._lock:
+            self._event.wait(1.0)  # BAD
+
+    def dequeue_under_lock(self):
+        with self._lock:
+            return self._queue.get()  # BAD: blocking queue op
+
+    def rpc_under_lock(self, ep, frame):
+        with self._lock:
+            return ep.verify(frame, timeout=2.0)  # BAD: timeout= call
+
+    def harvest_under_lock(self, future):
+        with self._lock:
+            return future.result()  # BAD: blocks on another worker
+
+    def reap_under_lock(self, worker_thread):
+        with self._lock:
+            worker_thread.join()  # BAD: thread join under the lock

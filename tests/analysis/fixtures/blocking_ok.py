@@ -1,0 +1,29 @@
+"""Fixture: the same operations outside the lock — clean."""
+
+import time
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = None
+        self._event = threading.Event()
+
+    def snapshot_then_wait(self):
+        with self._lock:
+            pending = self._queue.get_nowait()  # non-blocking variant is fine
+        time.sleep(0.1)
+        self._event.wait(1.0)
+        return pending
+
+    def plain_lookups_under_lock(self, mapping):
+        with self._lock:
+            # dict.get / str.join(iterable) are not blocking ops
+            return mapping.get("key", "-".join(["a", "b"]))
+
+    def reap_outside_lock(self, worker_thread, future):
+        with self._lock:
+            done = True
+        worker_thread.join()
+        return done and future.result()

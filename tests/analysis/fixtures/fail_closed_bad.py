@@ -1,0 +1,25 @@
+"""Fixture: verdict function defaulting True on error — must flag."""
+
+
+def verify_package(frame):
+    try:
+        return frame.check()
+    except Exception:
+        return True  # BAD: fails open
+
+
+class Decoder:
+    def decode_verdict(self, payload):
+        try:
+            return payload[0] == 1
+        except (IndexError, TypeError):
+            return True  # BAD: fails open
+
+
+def is_acceptable(frame) -> bool:
+    """No verify/verdict in the name: the `-> bool` annotation is what
+    marks this as a verdict function."""
+    try:
+        return frame.ok
+    except AttributeError:
+        return True  # BAD: fails open

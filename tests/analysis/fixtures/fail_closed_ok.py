@@ -1,0 +1,44 @@
+"""Fixture: verdict functions failing closed — clean."""
+
+
+def verify_package(frame):
+    try:
+        return frame.check()
+    except Exception:
+        return False
+
+
+def decode_verdict(payload):
+    try:
+        return payload[0] == 1
+    except (IndexError, TypeError):
+        raise ValueError("malformed verdict frame")
+
+
+def load_config(path):
+    """Not a verdict function: returning True from except is ugly but
+    out of this rule's scope (no marker name, no -> bool annotation)."""
+    try:
+        return path.read_text()
+    except OSError:
+        return True
+
+
+def is_acceptable(frame) -> bool:
+    """bool-annotated verdict function failing closed — clean."""
+    try:
+        return frame.ok
+    except AttributeError:
+        return False
+
+
+def verify_batch(frames):
+    """A nested helper's returns are not the enclosing verdict path."""
+    try:
+        return all(verify_package(f) for f in frames)
+    except Exception:
+        def fmt(e):
+            return True  # nested def inside the handler: not walked
+
+        fmt(None)
+        return False

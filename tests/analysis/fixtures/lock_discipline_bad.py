@@ -1,0 +1,33 @@
+"""Fixture: guarded attribute touched outside its lock — must flag."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded by: _lock
+        self.healthy = True  # guarded by: _lock [shared]
+        # BAD: the lambda body runs AFTER construction, from whatever
+        # thread calls depth_fn, without the lock — __init__'s
+        # exemption must not leak into deferred scopes
+        self.depth_fn = lambda: self._count
+
+    def bump(self):
+        self._count += 1  # BAD: no lock held
+
+    def read(self):
+        return self._count  # BAD: no lock held
+
+
+def poke(ep):
+    ep.healthy = False  # BAD: [shared] widens to non-self receivers
+
+
+class Rival:
+    """BAD: redeclares a [shared] attribute name under a different
+    guard — non-self accesses can no longer be attributed to either
+    declaration."""
+
+    def __init__(self):
+        self.healthy = True  # guarded by: _other_lock [shared]

@@ -1,0 +1,42 @@
+"""Fixture: guarded attributes only touched under the lock — clean."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded by: _lock
+        self._buffered = []  # guarded by: event-loop (single-threaded)
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def read(self):
+        with self._lock:
+            return self._count
+
+    def stash(self, item):
+        # documentation-only guard ("event-loop" is not an identifier):
+        # nothing is enforced for _buffered
+        self._buffered.append(item)
+
+    def snapshot(self):
+        with self._lock:
+            # a lambda built and CALLED under the lock still counts as
+            # deferred (lexical tracking can't prove call time), so it
+            # reads via a local captured under the lock instead
+            count = self._count
+            return (lambda: count)()
+
+
+class Other:
+    """Same attribute name in an unrelated class: not the declaring
+    class, so the (non-shared) guard does not apply."""
+
+    def __init__(self):
+        self._count = 7
+
+    def read(self):
+        return self._count

@@ -1,0 +1,17 @@
+"""Fixture: wall-clock duration/deadline math — must flag."""
+
+import time
+from time import time as now
+
+
+def elapsed(t0):
+    return time.time() - t0  # BAD
+
+
+def deadline_passed(deadline):
+    return time.time() > deadline  # BAD: comparison
+
+
+def accumulate(total):
+    total += now() - 0.5  # BAD: via from-import alias
+    return total

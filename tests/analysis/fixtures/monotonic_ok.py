@@ -1,0 +1,21 @@
+"""Fixture: monotonic durations and pure wall-clock timestamps — clean."""
+
+import time
+
+
+def elapsed(t0):
+    return time.monotonic() - t0
+
+
+def precise(t0):
+    return time.perf_counter() - t0
+
+
+def stamp():
+    # pure timestamp (no arithmetic/comparison): legal wall-clock use
+    return {"at": time.time()}
+
+
+def stamp_ms():
+    # scaling to milliseconds is multiplication, not duration math
+    return int(time.time() * 1000)

@@ -1,0 +1,7 @@
+"""Fixture: pragma without a reason — the pragma itself is a finding."""
+
+import time
+
+
+def elapsed(t0):
+    return time.time() - t0  # lint: allow(monotonic-durations)

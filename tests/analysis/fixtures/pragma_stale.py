@@ -1,0 +1,7 @@
+"""Fixture: a pragma that no longer suppresses anything — stale."""
+
+import time
+
+
+def elapsed(t0):
+    return time.monotonic() - t0  # lint: allow(monotonic-durations) — fixture: the violation was fixed but the pragma stayed

@@ -1,0 +1,19 @@
+"""Fixture: every violation carries a justified pragma — clean."""
+
+import time
+
+
+def elapsed(t0):
+    return time.time() - t0  # lint: allow(monotonic-durations) — fixture: justified wall-clock math
+
+
+def deadline_passed(deadline):
+    # lint: allow(monotonic-durations) — fixture: comment-line pragma covers the next line
+    return time.time() > deadline
+
+
+def scoped():  # lint: allow(monotonic-durations) — fixture: def-line pragma covers the whole body
+    t0 = time.time()
+    a = time.time() - t0
+    b = time.time() - t0
+    return a + b

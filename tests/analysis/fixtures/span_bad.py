@@ -1,0 +1,18 @@
+"""Fixture: spans entered outside `with` — must flag."""
+
+
+def leak_span(tracer):
+    sp = tracer.span("bls_verify")  # BAD: never ends
+    sp.set_tag("k", "v")
+    return sp
+
+
+def leak_constructed(slot):
+    span = Span("gossip", slot)  # BAD: bare construction
+    return span
+
+
+class Span:
+    def __init__(self, name, slot):
+        self.name = name
+        self.slot = slot

@@ -1,0 +1,15 @@
+"""Fixture: CLI flags with wiring gaps — must flag."""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--dead-flag", action="store_true")  # declared, never read
+    args = ap.parse_args()
+    serve(args.port, args.ghost)  # args.ghost has no declaring flag
+
+
+def serve(port, ghost):
+    return port, ghost

@@ -1,0 +1,12 @@
+"""Fixture: metric families with dashboard gaps — must flag."""
+
+
+class Metrics:
+    def __init__(self, creator):
+        # on a dashboard: fine
+        self.ok = creator.counter("lodestar_fixture_served_total", "served")
+        # on NO dashboard and not allowlisted: flagged
+        self.orphan = creator.gauge("lodestar_fixture_orphan_depth", "depth")
+        # counter panelled WITHOUT the _total suffix: the dashboard-side
+        # token check flags the unsuffixed reference
+        self.dropped = creator.counter("lodestar_fixture_dropped", "dropped")

@@ -1,0 +1,13 @@
+"""Fixture: node options with wiring gaps — must flag."""
+
+
+class BeaconNodeOptions:
+    def __init__(self, port=9000, dead_opt=None):
+        self.port = port
+        self.dead_opt = dead_opt  # stored, node never reads it
+
+
+class BeaconNode:
+    def __init__(self, opts):
+        self.port = opts.port
+        self.extra = opts.never_stored  # read, never stored

@@ -1,0 +1,15 @@
+"""Fixture: every flag consumed, every read declared — clean."""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--data-dir", dest="datadir", default="/tmp")
+    args = ap.parse_args()
+    serve(args.port, args.datadir)
+
+
+def serve(port, datadir):
+    return port, datadir

@@ -1,0 +1,17 @@
+"""Fixture: every family panelled (with correct sample derivation) or
+allowlisted — clean. `lodestar_fixture_allowlisted_total` relies on the
+test injecting an allowlist entry."""
+
+
+class Metrics:
+    def __init__(self, creator):
+        self.served = creator.counter("lodestar_fixture_served_total", "served")
+        # declared WITHOUT _total; prometheus_client still exposes
+        # <name>_total, and the dashboard references the suffixed sample
+        self.dropped = creator.counter("lodestar_fixture_dropped", "dropped")
+        self.wait = creator.histogram("lodestar_fixture_wait_seconds", "wait")
+        # summaries expose <name>, <name>_sum, <name>_count; the
+        # dashboard references only the _sum/_count samples
+        self.rtt = creator.summary("lodestar_fixture_rtt_seconds", "rtt")
+        self.depth = creator.gauge("lodestar_fixture_depth", "depth")
+        self.allow = creator.counter("lodestar_fixture_allowlisted_total", "quiet")

@@ -1,0 +1,13 @@
+"""Fixture: every stored option read, every read stored — clean."""
+
+
+class BeaconNodeOptions:
+    def __init__(self, port=9000, datadir="/tmp"):
+        self.port = port
+        self.datadir = datadir
+
+
+class BeaconNode:
+    def __init__(self, opts):
+        self.port = opts.port
+        self.datadir = opts.datadir

@@ -1,0 +1,185 @@
+"""Per-rule fixture tests: every checker fires on its positive fixture
+and stays quiet on its negative one, suppression pragmas and the
+allowlist work, and pragma hygiene reports reasonless/stale pragmas."""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+
+from tools.analysis import analyze
+from tools.analysis.rules import RULES_BY_NAME
+from tools.analysis.rules import wiring as wiring_mod
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def run_rule(name: str, fixture: str, hygiene: bool = False):
+    return analyze(
+        [FIXTURES / fixture],
+        rules=[RULES_BY_NAME[name]],
+        pragma_hygiene=hygiene,
+    )
+
+
+# (rule, bad fixture, expected finding count, ok fixture)
+CASES = [
+    ("lock-discipline", "lock_discipline_bad.py", 5, "lock_discipline_ok.py"),
+    ("blocking-under-lock", "blocking_bad.py", 6, "blocking_ok.py"),
+    ("fail-closed-verdicts", "fail_closed_bad.py", 3, "fail_closed_ok.py"),
+    ("span-discipline", "span_bad.py", 2, "span_ok.py"),
+    ("monotonic-durations", "monotonic_bad.py", 3, "monotonic_ok.py"),
+]
+
+
+@pytest.mark.parametrize("rule,bad,count,ok", CASES, ids=[c[0] for c in CASES])
+def test_rule_fires_on_bad_and_passes_ok(rule, bad, count, ok):
+    findings = run_rule(rule, bad)
+    assert len(findings) == count, [f.format() for f in findings]
+    assert all(f.rule == rule for f in findings)
+    # file:line rule message output contract
+    for f in findings:
+        assert f.format().startswith(f"{f.path}:{f.line} {rule} ")
+    assert run_rule(rule, ok) == []
+
+
+def test_lock_discipline_details():
+    findings = run_rule("lock-discipline", "lock_discipline_bad.py")
+    msgs = " | ".join(f.message for f in findings)
+    assert "'_count' is guarded by '_lock'" in msgs
+    # [shared] widens to non-self receivers
+    assert "'healthy' is guarded by '_lock'" in msgs
+    # the lambda in __init__ is deferred execution: __init__'s
+    # exemption must not cover it (the depth_fn bug class)
+    lambda_line = 14  # self.depth_fn = lambda: self._count
+    assert any(f.line == lambda_line for f in findings), [f.format() for f in findings]
+    # redeclaring a [shared] attribute under a different guard is
+    # ambiguous, not a silent overwrite
+    assert "conflicting guard declarations" in msgs
+
+
+def test_blocking_under_lock_details():
+    findings = run_rule("blocking-under-lock", "blocking_bad.py")
+    msgs = " | ".join(f.message for f in findings)
+    assert "time.sleep()" in msgs
+    assert ".wait()" in msgs
+    assert "blocking queue .get()" in msgs
+    assert "timeout= call" in msgs
+    assert "future.result()" in msgs
+    assert "worker_thread.join()" in msgs
+
+
+# -- suppression pragmas ------------------------------------------------------
+
+
+def test_pragma_suppresses_same_line_comment_line_and_def_scope():
+    assert run_rule("monotonic-durations", "pragma_suppressed.py") == []
+
+
+def test_pragma_without_reason_is_itself_a_finding():
+    findings = run_rule("monotonic-durations", "pragma_no_reason.py", hygiene=True)
+    rules = sorted(f.rule for f in findings)
+    # the reasonless pragma is malformed AND fails to suppress the
+    # underlying monotonic finding
+    assert rules == ["monotonic-durations", "pragma"]
+    pragma = next(f for f in findings if f.rule == "pragma")
+    assert "no reason" in pragma.message
+
+
+def test_stale_pragma_reported_on_full_runs_only():
+    stale = run_rule("monotonic-durations", "pragma_stale.py", hygiene=True)
+    assert [f.rule for f in stale] == ["pragma"]
+    assert "stale suppression" in stale[0].message
+    # single-rule runs skip hygiene: a pragma for a rule that did not
+    # run cannot be judged stale
+    assert run_rule("monotonic-durations", "pragma_stale.py") == []
+
+
+# -- metrics-and-cli-wiring (project-scoped) ----------------------------------
+
+
+def wiring_findings(root: str):
+    return analyze(
+        [],
+        rules=[RULES_BY_NAME["metrics-and-cli-wiring"]],
+        repo_root=FIXTURES / root,
+        pragma_hygiene=False,
+    )
+
+
+def test_wiring_flags_every_gap_class(monkeypatch):
+    # whole-dict replacement: the real entries describe lodestar_tpu/
+    # families and would all read as stale against a fixture tree
+    monkeypatch.setattr(
+        wiring_mod,
+        "UNPANELLED_ALLOWLIST",
+        {"lodestar_fixture_allowlisted_total": "fixture: exercising the allowlist path"},
+    )
+    msgs = [f"{pathlib.Path(f.path).name}: {f.message}" for f in wiring_findings("wiring_bad")]
+    joined = " | ".join(msgs)
+    # dashboard -> registry: unknown token, and a counter referenced
+    # without the _total suffix prometheus_client appends
+    assert "references 'lodestar_fixture_never_registered_total'" in joined
+    assert "references 'lodestar_fixture_dropped'" in joined
+    # registry -> dashboard: unpanelled family (twice: the orphan gauge
+    # and the counter whose only reference lacks the suffix)
+    assert "'lodestar_fixture_orphan_depth' (gauge) is on no dashboard" in joined
+    assert "'lodestar_fixture_dropped' (counter) is on no dashboard" in joined
+    # allowlist staleness: wiring_bad never registers the allowlisted
+    # family, so its entry is a standing license — flagged
+    assert "'lodestar_fixture_allowlisted_total' names no registered" in joined
+    # CLI two-way
+    assert "--dead-flag" in joined and "never consumed" in joined
+    assert "args.ghost is consumed but no CLI flag" in joined
+    # node options two-way
+    assert "BeaconNodeOptions.dead_opt is stored" in joined
+    assert "opts.never_stored" in joined
+    assert len(msgs) == 9, joined
+
+
+def test_wiring_clean_tree_with_allowlist(monkeypatch):
+    monkeypatch.setattr(
+        wiring_mod,
+        "UNPANELLED_ALLOWLIST",
+        {"lodestar_fixture_allowlisted_total": "fixture: exercising the allowlist path"},
+    )
+    assert wiring_findings("wiring_ok") == []
+
+
+def test_wiring_allowlist_is_what_silences_the_unpanelled_family(monkeypatch):
+    monkeypatch.setattr(wiring_mod, "UNPANELLED_ALLOWLIST", {})
+    findings = wiring_findings("wiring_ok")
+    assert len(findings) == 1
+    assert "lodestar_fixture_allowlisted_total" in findings[0].message
+    assert "UNPANELLED_ALLOWLIST" in findings[0].message
+
+
+def test_pragma_suppressing_project_rule_finding_not_stale_under_path_spelling(
+    monkeypatch, tmp_path
+):
+    """analyze() keys its source cache by RESOLVED path: a project rule
+    emits absolute finding paths while the analyzed files may have been
+    passed under another spelling (relative, or with '..' segments). A
+    spelling-keyed cache loads the same file twice, suppresses the
+    finding on one copy, and reports the other copy's identical pragma
+    as a stale suppression — failing a clean tree."""
+    monkeypatch.setattr(wiring_mod, "UNPANELLED_ALLOWLIST", {})
+    pkg = tmp_path / "lodestar_tpu"
+    pkg.mkdir()
+    (pkg / "metrics_mod.py").write_text(
+        "class M:\n"
+        "    def __init__(self, creator):\n"
+        "        # lint: allow(metrics-and-cli-wiring) — fixture: unpanelled on purpose\n"
+        '        self.g = creator.gauge("lodestar_unpanelled_depth", "d")\n'
+    )
+    (tmp_path / "dashboards").mkdir()
+    (tmp_path / "dashboards" / "d.json").write_text('{"panels": []}')
+    unnormalized = pkg / ".." / "lodestar_tpu"
+    findings = analyze(
+        [unnormalized],
+        rules=[RULES_BY_NAME["metrics-and-cli-wiring"]],
+        repo_root=tmp_path,
+        pragma_hygiene=True,
+    )
+    assert findings == [], [f.format() for f in findings]

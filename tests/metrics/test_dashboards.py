@@ -1,82 +1,40 @@
-"""Dashboard consistency: every Prometheus metric name referenced by a
-panel expr in dashboards/*.json must exist in the registry built by
-create_metrics(), and re-running tools/gen_dashboards.py must be a
-no-op against the checked-in JSON."""
+"""Dashboard consistency.
+
+The registry<->dashboard two-way check (every panel expr token is a
+sample a registered family can expose — with prometheus_client's
+``_total``/``_bucket``/``_sum``/``_count`` derivation — and every
+``lodestar_*`` family is panelled or allowlisted) lives in the
+static-analysis pass now: ``tools/analysis`` rule
+``metrics-and-cli-wiring``, gated by ``tests/analysis/test_gate.py``.
+This module keeps the thin wrapper plus the pieces the rule does not
+cover: regen-is-noop and named must-have incident panels."""
 
 from __future__ import annotations
 
 import importlib.util
 import json
 import pathlib
-import re
 
-from lodestar_tpu.metrics import create_metrics
+from tools.analysis import analyze
+from tools.analysis.rules import RULES_BY_NAME
 
 REPO = pathlib.Path(__file__).resolve().parents[2]
 DASHBOARDS = REPO / "dashboards"
 
-# PromQL functions/keywords that survive the identifier regex
-_PROMQL_WORDS = {
-    "histogram_quantile",
-    "label_replace",
-    "label_join",
-    "group_left",
-    "group_right",
-    "count_values",
-}
 
-
-def _registry_sample_names() -> set[str]:
-    """Every sample name the registry can expose. Derived from family
-    name + type (labeled metrics with no observations yet emit no
-    samples, so enumerating family.samples would under-report)."""
-    m = create_metrics()
-    names: set[str] = set()
-    for family in m.creator.registry.collect():
-        n = family.name
-        if family.type == "counter":
-            names.add(n + "_total")
-        elif family.type == "histogram":
-            names.update({n + "_bucket", n + "_sum", n + "_count"})
-        elif family.type == "summary":
-            names.update({n, n + "_sum", n + "_count"})
-        else:
-            names.add(n)
-    return names
-
-
-def _referenced_metric_names() -> set[tuple[str, str]]:
-    refs: set[tuple[str, str]] = set()
-    files = sorted(DASHBOARDS.glob("*.json"))
-    assert len(files) >= 8, "expected the 8 generated dashboards"
-    for path in files:
-        dash = json.loads(path.read_text())
-        for panel in dash["panels"]:
-            for target in panel.get("targets", []):
-                for token in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", target["expr"]):
-                    # metric names in this repo all carry an underscore;
-                    # bare words (by, le, rate, sum, label names) don't
-                    if "_" in token and token not in _PROMQL_WORDS:
-                        refs.add((path.name, token))
-    return refs
-
-
-def test_every_panel_expr_metric_exists_in_registry():
-    names = _registry_sample_names()
-    missing = sorted(
-        (fname, token) for fname, token in _referenced_metric_names() if token not in names
+def test_registry_and_dashboards_agree_both_ways():
+    """Thin wrapper over the static-analysis wiring rule (kept here so
+    a dashboard regression fails the metrics suite too, with the same
+    file:line findings the CLI prints). Asserts the WHOLE rule clean —
+    filtering findings by message wording would silently drop classes
+    of regression (e.g. stale allowlist entries) as messages evolve."""
+    findings = analyze(
+        [],
+        rules=[RULES_BY_NAME["metrics-and-cli-wiring"]],
+        repo_root=REPO,
+        pragma_hygiene=False,
     )
-    assert not missing, f"dashboard exprs reference unknown metrics: {missing}"
-
-
-def test_trace_dashboard_covers_trace_metrics():
-    dash = json.loads((DASHBOARDS / "lodestar_block_pipeline_trace.json").read_text())
-    exprs = " ".join(
-        t["expr"] for p in dash["panels"] for t in p.get("targets", [])
-    )
-    assert "lodestar_trace_block_pipeline_seconds_bucket" in exprs
-    assert "lodestar_trace_span_duration_seconds" in exprs
-    assert "lodestar_trace_slow_slot_total" in exprs
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
 
 def test_gen_dashboards_regen_is_noop(tmp_path):
@@ -95,26 +53,23 @@ def test_gen_dashboards_regen_is_noop(tmp_path):
         )
 
 
-def test_audit_dashboard_covers_every_audit_metric():
-    """Both directions for the audit family: every expr token in the
-    audit dashboard exists in the registry (the general test), AND every
-    lodestar_offload_audit_* family registered in metrics/__init__.py is
-    actually panelled — a new audit metric without a panel is a blind
-    spot in the one dashboard operators watch during an incident.
-    (prometheus_client appends _total to counters: the expr must use the
-    suffixed sample name, which _registry_sample_names() encodes.)"""
-    dash = json.loads((DASHBOARDS / "lodestar_offload_audit.json").read_text())
-    exprs = " ".join(t["expr"] for p in dash["panels"] for t in p.get("targets", []))
+def _exprs(dashboard_name: str) -> str:
+    dash = json.loads((DASHBOARDS / dashboard_name).read_text())
+    return " ".join(t["expr"] for p in dash["panels"] for t in p.get("targets", []))
 
-    m = create_metrics()
-    audit_families = [
-        f for f in m.creator.registry.collect() if f.name.startswith("lodestar_offload_audit")
-    ]
-    assert len(audit_families) >= 8, "expected the full AuditMetrics family"
-    for family in audit_families:
-        sample = family.name + "_total" if family.type == "counter" else family.name
-        assert sample in exprs, f"audit metric {sample} has no panel"
-    # the non-negotiable incident panels
+
+def test_trace_dashboard_covers_trace_metrics():
+    exprs = _exprs("lodestar_block_pipeline_trace.json")
+    assert "lodestar_trace_block_pipeline_seconds_bucket" in exprs
+    assert "lodestar_trace_span_duration_seconds" in exprs
+    assert "lodestar_trace_slow_slot_total" in exprs
+
+
+def test_audit_dashboard_keeps_the_incident_panels():
+    """The non-negotiable panels operators watch during a Byzantine
+    incident (the generic every-family-panelled direction is the
+    static-analysis rule's job now)."""
+    exprs = _exprs("lodestar_offload_audit.json")
     assert "lodestar_offload_audit_trust_score" in exprs
     assert "lodestar_offload_audit_quarantined" in exprs
     assert "lodestar_offload_audit_byzantine_total" in exprs

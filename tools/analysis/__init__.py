@@ -1,0 +1,13 @@
+"""Project-invariant static analysis for lodestar-tpu.
+
+Run: ``python -m tools.analysis [--rule NAME ...] [paths...]``
+Gate: ``tests/analysis/`` runs every rule over ``lodestar_tpu/`` in
+tier-1 and fails on any finding.
+
+See ``tools/analysis/core.py`` for the framework (findings, pragmas,
+runner) and ``tools/analysis/rules/`` for the individual checkers.
+"""
+
+from .core import Finding, Rule, SourceFile, analyze, iter_py_files
+
+__all__ = ["Finding", "Rule", "SourceFile", "analyze", "iter_py_files"]

@@ -1,0 +1,74 @@
+"""CLI: ``python -m tools.analysis [--rule NAME ...] [paths...]``.
+
+Prints ``path:line rule message`` per finding and exits non-zero when
+anything fired. Default paths: ``lodestar_tpu/`` relative to the repo
+root (so a bare ``python -m tools.analysis`` from the repo root checks
+the whole tree).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from .core import analyze
+from .rules import ALL_RULES, RULES_BY_NAME
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="lodestar-tpu project-invariant static analysis",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=[],
+        metavar="NAME",
+        help="run only this rule (repeatable); default: all rules "
+        "plus pragma hygiene",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    ap.add_argument(
+        "--stats", action="store_true", help="print file/timing summary"
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories (default: lodestar_tpu/)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name:24s} {r.description}")
+        return 0
+
+    rules = None
+    if args.rule:
+        unknown = sorted(set(args.rule) - set(RULES_BY_NAME))
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            print(f"known: {', '.join(sorted(RULES_BY_NAME))}", file=sys.stderr)
+            return 2
+        rules = [RULES_BY_NAME[n] for n in dict.fromkeys(args.rule)]
+
+    paths = args.paths or [str(REPO_ROOT / "lodestar_tpu")]
+    t0 = time.monotonic()
+    findings = analyze(paths, rules=rules, repo_root=REPO_ROOT)
+    dt = time.monotonic() - t0
+    for f in findings:
+        print(f.format())
+    if args.stats or findings:
+        n_rules = len(rules) if rules is not None else len(ALL_RULES)
+        print(
+            f"{len(findings)} finding(s) from {n_rules} rule(s) in {dt:.2f}s",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

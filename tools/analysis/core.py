@@ -1,0 +1,262 @@
+"""Core of the project static-analysis pass: findings, suppression
+pragmas, parsed source files, and the runner.
+
+The checkers encode invariants this repo has already shipped bugs
+against (lock discipline, fail-closed verdict paths, context-managed
+spans, monotonic duration math, metrics/CLI wiring). They are AST-based
+(stdlib `ast` only) and run as a tier-1 gate (`tests/analysis/`) plus a
+CLI: `python -m tools.analysis [--rule NAME] [paths...]`.
+
+Suppression pragma (same line as the finding, or on a `def`/`class`
+line to cover the whole scope)::
+
+    # lint: allow(rule-name) — why this is intentionally exempt
+
+A reason is REQUIRED: a pragma without one is itself reported (rule
+`pragma`), as is a pragma naming an unknown rule or — on full-rule runs
+— a pragma that no longer suppresses anything (stale suppressions rot
+into licenses to regress).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+__all__ = [
+    "Finding",
+    "Pragma",
+    "SourceFile",
+    "Rule",
+    "analyze",
+    "iter_py_files",
+]
+
+#: `# lint: allow(rule[, rule...])` with a mandatory free-text reason
+#: after an em/en dash, double hyphen, or single hyphen separator
+_PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*allow\(([^)]*)\)\s*(?:(?:—|–|--|-|:)\s*(\S.*))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: frozenset
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed module: text, AST, comments, and suppression pragmas."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        #: line -> comment text (via tokenize, so '#' inside strings is
+        #: not mistaken for a comment)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass
+        self.pragmas: dict[int, Pragma] = {}
+        self.malformed_pragmas: list[Finding] = []
+        for line, comment in self.comments.items():
+            if "lint:" not in comment:
+                continue
+            m = _PRAGMA_RE.search(comment)
+            if m is None:
+                self.malformed_pragmas.append(
+                    Finding("pragma", path, line, f"unparseable lint pragma: {comment.strip()!r}")
+                )
+                continue
+            rules = frozenset(r.strip() for r in m.group(1).split(",") if r.strip())
+            reason = (m.group(2) or "").strip()
+            if not rules:
+                self.malformed_pragmas.append(
+                    Finding("pragma", path, line, "lint pragma names no rule")
+                )
+                continue
+            if not reason:
+                self.malformed_pragmas.append(
+                    Finding(
+                        "pragma", path, line,
+                        "suppression pragma carries no reason "
+                        "(format: # lint: allow(rule) — why)",
+                    )
+                )
+                continue
+            self.pragmas[line] = Pragma(line, rules, reason)
+        #: (first_line, last_line, pragma) for pragmas sitting on a
+        #: def/class line: they cover the whole scope
+        self.scoped: list[tuple[int, int, Pragma]] = []
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    p = self.pragmas.get(node.lineno)
+                    if p is not None:
+                        self.scoped.append((node.lineno, node.end_lineno or node.lineno, p))
+
+    @classmethod
+    def load(cls, path: str | Path) -> "SourceFile":
+        p = Path(path)
+        return cls(str(p), p.read_text(encoding="utf-8"))
+
+    def _comment_only(self, line: int) -> bool:
+        text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        return text.lstrip().startswith("#")
+
+    def suppression(self, rule: str, line: int) -> Pragma | None:
+        """The pragma suppressing `rule` at `line`: same-line trailing
+        comment, a comment-only pragma line immediately above, or an
+        enclosing def/class-scope pragma."""
+        p = self.pragmas.get(line)
+        if p is not None and rule in p.rules:
+            return p
+        p = self.pragmas.get(line - 1)
+        if p is not None and rule in p.rules and self._comment_only(line - 1):
+            return p
+        for first, last, sp in self.scoped:
+            if first <= line <= last and rule in sp.rules:
+                return sp
+        return None
+
+
+class Rule:
+    """Base: per-file rules implement `check(sf)`; project-scoped rules
+    set `scope = "project"` and implement `check_project(repo_root)`."""
+
+    name: str = ""
+    description: str = ""
+    scope: str = "file"
+
+    def check(self, sf: SourceFile):  # pragma: no cover - interface
+        return ()
+
+    def check_project(self, repo_root: Path, sources=None):  # pragma: no cover - interface
+        """`sources` (resolved-path -> SourceFile) lets a project rule
+        reuse the trees analyze() already parsed."""
+        return ()
+
+
+def iter_py_files(paths) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            out.append(p)
+    return sorted(set(out))
+
+
+def analyze(
+    paths,
+    *,
+    rules=None,
+    repo_root: Path | None = None,
+    pragma_hygiene: bool | None = None,
+) -> list[Finding]:
+    """Run `rules` (default: all registered) over `paths`. Project-scoped
+    rules run once against `repo_root` (default: this repo). Returns the
+    unsuppressed findings, sorted; on full-rule runs, stale/malformed
+    pragmas are reported under the `pragma` rule (`pragma_hygiene`
+    overrides that default — tests exercise hygiene against a single
+    rule without paying for the project-scoped ones)."""
+    from .rules import ALL_RULES
+
+    selected = list(ALL_RULES) if rules is None else list(rules)
+    full_run = (rules is None) if pragma_hygiene is None else pragma_hygiene
+    if repo_root is None:
+        repo_root = Path(__file__).resolve().parents[2]
+
+    # keyed by RESOLVED path: per-file rules emit findings spelled the
+    # way the caller passed the path (possibly relative) while project
+    # rules emit absolute paths — a spelling-keyed cache would load the
+    # same file twice and mark a pragma used on one copy while the
+    # other copy's identical pragma reports stale
+    sources: dict[str, SourceFile] = {}
+    analyzed: set[str] = set()
+
+    def source_for(path: str) -> SourceFile | None:
+        key = str(Path(path).resolve())
+        sf = sources.get(key)
+        if sf is None and Path(path).suffix == ".py" and Path(path).exists():
+            sf = sources[key] = SourceFile.load(path)
+        return sf
+
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
+        sf = SourceFile.load(f)
+        key = str(f.resolve())
+        sources[key] = sf
+        analyzed.add(key)
+        if sf.parse_error is not None:
+            findings.append(Finding("parse", sf.path, 1, f"syntax error: {sf.parse_error}"))
+
+    raw: list[Finding] = []
+    for rule in selected:
+        if rule.scope == "project":
+            raw.extend(rule.check_project(repo_root, sources=sources))
+        else:
+            for path in sorted(analyzed):
+                sf = sources[path]
+                if sf.tree is not None:
+                    raw.extend(rule.check(sf))
+
+    for fnd in raw:
+        sf = source_for(fnd.path)
+        if sf is not None:
+            p = sf.suppression(fnd.rule, fnd.line)
+            if p is not None:
+                p.used = True
+                continue
+        findings.append(fnd)
+
+    if full_run:
+        # pragma hygiene only for files the caller actually analyzed —
+        # files loaded lazily for suppression lookups (e.g. a wiring
+        # finding's declaration site) did not have every rule run over
+        # them, so their other pragmas cannot be judged stale
+        for path in sorted(analyzed):
+            sf = sources[path]
+            findings.extend(sf.malformed_pragmas)
+            for p in sf.pragmas.values():
+                if not p.used:
+                    findings.append(
+                        Finding(
+                            "pragma", sf.path, p.line,
+                            f"stale suppression: allow({', '.join(sorted(p.rules))}) "
+                            "no longer matches any finding — remove it",
+                        )
+                    )
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

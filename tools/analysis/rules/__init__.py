@@ -1,0 +1,23 @@
+"""Rule registry: one instance of every project checker."""
+
+from __future__ import annotations
+
+from .blocking_under_lock import BlockingUnderLockRule
+from .fail_closed import FailClosedVerdictsRule
+from .lock_discipline import LockDisciplineRule
+from .monotonic import MonotonicDurationsRule
+from .span_discipline import SpanDisciplineRule
+from .wiring import MetricsCliWiringRule
+
+ALL_RULES = (
+    LockDisciplineRule(),
+    BlockingUnderLockRule(),
+    FailClosedVerdictsRule(),
+    SpanDisciplineRule(),
+    MonotonicDurationsRule(),
+    MetricsCliWiringRule(),
+)
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_NAME"]

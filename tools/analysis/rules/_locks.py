@@ -1,0 +1,130 @@
+"""Shared lexical lock tracking for the lock-aware rules.
+
+"Holding a lock" is approximated lexically: code is considered under
+lock `L` while it sits inside a `with`/`async with` statement one of
+whose context expressions is the bare name `L` or an attribute access
+ending in `.L` (`with self._lock:`, `with client._fs_lock:`). Lock
+IDENTITY is not modeled — `with self._lock` in one object and a guarded
+attribute of another object that happens to use the same lock attribute
+name both pass. That is deliberate: the checker enforces the repo's
+naming discipline (every shared-state lock is an attribute whose name
+ends in `lock`), and the annotation names which attribute guards what.
+"""
+
+from __future__ import annotations
+
+import ast
+
+__all__ = ["lock_names_of_with", "looks_like_lock", "WithLockTracker"]
+
+
+def _last_segment(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def lock_names_of_with(node: ast.With | ast.AsyncWith) -> list[str]:
+    """The trailing name of each context expression (with `as` targets
+    ignored — a lock is entered, not bound)."""
+    names = []
+    for item in node.items:
+        seg = _last_segment(item.context_expr)
+        if seg is not None:
+            names.append(seg)
+    return names
+
+
+def looks_like_lock(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+class WithLockTracker(ast.NodeVisitor):
+    """Visitor base that maintains `self.held` — the multiset of lock
+    names whose `with` blocks lexically enclose the current node — and
+    `self.func_stack` / `self.class_stack` for scope queries."""
+
+    def __init__(self) -> None:
+        self.held: list[str] = []
+        self.func_stack: list[str] = []
+        self.class_stack: list[str] = []
+
+    # -- scope bookkeeping ----------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._visit_with(node)
+
+    def _visit_with(self, node) -> None:
+        names = [n for n in lock_names_of_with(node) if looks_like_lock(n)]
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held.extend(names)
+        for stmt in node.body:
+            self.visit(stmt)
+        if names:
+            del self.held[-len(names):]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node)
+
+    def _visit_func(self, node) -> None:
+        # decorators and default values evaluate AT DEF TIME, under
+        # whatever locks enclose the def
+        for dec in node.decorator_list:
+            self.visit(dec)
+        self._visit_defaults(node.args)
+        # ...but the BODY is deferred: when it eventually runs, the
+        # locks lexically enclosing the def are not (necessarily) held,
+        # and an enclosing __init__ no longer confines the object — a
+        # `depth_fn = lambda: self._pending` built in __init__ executes
+        # later from scrape threads without the lock
+        self.func_stack.append(node.name)
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+        self.func_stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_defaults(node.args)
+        self.func_stack.append("<lambda>")
+        saved, self.held = self.held, []
+        self.visit(node.body)
+        self.held = saved
+        self.func_stack.pop()
+
+    def _visit_defaults(self, args: ast.arguments) -> None:
+        for d in args.defaults:
+            self.visit(d)
+        for d in args.kw_defaults:
+            if d is not None:
+                self.visit(d)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    # -- queries --------------------------------------------------------------
+
+    def holds(self, lock: str) -> bool:
+        return lock in self.held
+
+    def in_init(self) -> bool:
+        """True only when the INNERMOST function scope is __init__ —
+        a nested def/lambda inside __init__ runs after construction,
+        when the object is already shared."""
+        return bool(self.func_stack) and self.func_stack[-1] == "__init__"
+
+    def current_class(self) -> str | None:
+        return self.class_stack[-1] if self.class_stack else None

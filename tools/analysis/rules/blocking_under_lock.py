@@ -1,0 +1,115 @@
+"""blocking-under-lock: no blocking waits inside a held lock.
+
+Inside any ``with ...<something>lock...:`` block, flag:
+
+* ``time.sleep(...)`` (module aliases and ``from time import sleep``
+  both recognized),
+* any ``.wait(...)`` call (``Event.wait``, ``Condition.wait``, thread
+  waits — all park the holder while other threads spin on the lock),
+* blocking ``.get(...)`` / ``.put(...)`` on queue-named receivers
+  (receiver's trailing name contains ``queue`` or ends in ``_q``;
+  ``get_nowait``/``put_nowait`` are different attribute names and pass),
+* any ``.result(...)`` call (a future's result blocks until another
+  worker — possibly one queued behind this very lock — completes),
+* zero-argument ``.join()`` (thread/process join; ``", ".join(parts)``
+  always takes the iterable, and ``join(timeout=...)`` is caught by
+  the ``timeout=`` check below),
+* any call carrying a ``timeout=`` keyword — in this codebase that is
+  the signature of an RPC or a bounded wait (``ep.verify(frame,
+  timeout=...)``), neither of which belongs under a lock.
+
+``str.join(iterable)`` / ``dict.get`` stay unflagged (receiver/arity/
+keyword filters above are what make this precise enough to gate on).
+A bare positional ``thread.join(5)`` is the one documented gap.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile
+from ._locks import WithLockTracker
+
+_QUEUEISH = ("queue",)
+
+
+def _receiver_name(expr: ast.expr) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _time_sleep_names(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(module aliases of `time`, local names bound to `time.sleep`)."""
+    mods: set[str] = set()
+    funcs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    funcs.add(a.asname or "sleep")
+    return mods, funcs
+
+
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    description = (
+        "no time.sleep / .wait() / blocking queue ops / .result() / "
+        "join() / timeout= calls inside a 'with ...lock:' body"
+    )
+
+    def check(self, sf: SourceFile):
+        findings: list[Finding] = []
+        time_mods, sleep_funcs = _time_sleep_names(sf.tree)
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(
+                Finding(
+                    BlockingUnderLockRule.name, sf.path, node.lineno,
+                    f"{what} inside a held lock blocks every other "
+                    "thread contending for it",
+                )
+            )
+
+        class _V(WithLockTracker):
+            def visit_Call(self, node: ast.Call) -> None:
+                if self.held:
+                    fn = node.func
+                    if (
+                        isinstance(fn, ast.Attribute)
+                        and fn.attr == "sleep"
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in time_mods
+                    ):
+                        flag(node, "time.sleep()")
+                    elif isinstance(fn, ast.Name) and fn.id in sleep_funcs:
+                        flag(node, "sleep()")
+                    elif isinstance(fn, ast.Attribute) and fn.attr == "wait":
+                        flag(node, f"{_receiver_name(fn.value)}.wait()")
+                    elif isinstance(fn, ast.Attribute) and fn.attr in ("get", "put"):
+                        recv = _receiver_name(fn.value).lower()
+                        if any(q in recv for q in _QUEUEISH) or recv.endswith("_q"):
+                            flag(node, f"blocking queue .{fn.attr}()")
+                    elif isinstance(fn, ast.Attribute) and fn.attr == "result":
+                        flag(node, f"{_receiver_name(fn.value)}.result()")
+                    elif (
+                        isinstance(fn, ast.Attribute)
+                        and fn.attr == "join"
+                        and not node.args
+                        and not node.keywords
+                    ):
+                        # zero-arg join is a thread/process join;
+                        # str.join always takes the iterable
+                        flag(node, f"{_receiver_name(fn.value)}.join()")
+                    elif any(kw.arg == "timeout" for kw in node.keywords):
+                        flag(node, "a timeout= call (RPC/bounded wait)")
+                self.generic_visit(node)
+
+        _V().visit(sf.tree)
+        return findings

@@ -1,0 +1,75 @@
+"""fail-closed-verdicts: no exception path in a verdict-returning
+function may resolve True.
+
+Verdict functions are identified by name — anything containing
+``verify`` or ``verdict`` (``verify_signature_sets``, ``decode_verdict``,
+``_verify_package``, ...) — or by an explicit ``-> bool`` return
+annotation. Inside such a function, a ``return True`` lexically inside
+an ``except`` handler is the bug class this repo's offload/pool layers
+are built to exclude: an error must degrade or reject, never default
+to "valid". Nested function definitions are not walked through (their
+returns are not the enclosing verdict path — they get their own
+check).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile
+
+_NAME_MARKERS = ("verify", "verdict")
+
+
+def _is_verdict_fn(node) -> bool:
+    low = node.name.lower()
+    if any(m in low for m in _NAME_MARKERS):
+        return True
+    return isinstance(node.returns, ast.Name) and node.returns.id == "bool"
+
+
+def _walk_shallow(stmts):
+    """Yield nodes under `stmts` without descending into nested function
+    definitions or lambdas."""
+    _skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    stack = [s for s in stmts if not isinstance(s, _skip)]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class FailClosedVerdictsRule(Rule):
+    name = "fail-closed-verdicts"
+    description = (
+        "no except path in a verify/verdict/'-> bool' function may return True"
+    )
+
+    def check(self, sf: SourceFile):
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_verdict_fn(node):
+                continue
+            for inner in _walk_shallow(node.body):
+                if not isinstance(inner, ast.ExceptHandler):
+                    continue
+                for stmt in _walk_shallow(inner.body):
+                    if (
+                        isinstance(stmt, ast.Return)
+                        and isinstance(stmt.value, ast.Constant)
+                        and stmt.value.value is True
+                    ):
+                        findings.append(
+                            Finding(
+                                self.name, sf.path, stmt.lineno,
+                                f"'{node.name}' returns True from an except "
+                                "handler — verdict paths must fail closed "
+                                "(re-raise, degrade, or return False)",
+                            )
+                        )
+        return findings

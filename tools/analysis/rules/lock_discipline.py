@@ -1,0 +1,163 @@
+"""lock-discipline: annotated shared attributes are only touched under
+their declared lock.
+
+Declaration convention — a trailing comment on the attribute's
+assignment (normally in ``__init__``)::
+
+    self._outstanding = 0          # guarded by: _lock
+    self.healthy = True            # guarded by: _lock [shared] — owning client's
+    self._buffered = []            # guarded by: event-loop (single-threaded)
+
+* ``# guarded by: <lock>`` — `<lock>` is a Python identifier naming the
+  guarding lock attribute (``_lock``, ``_fs_lock``, ...). Every
+  load/store of ``self.<attr>`` in the DECLARING class must sit inside
+  a ``with ...<lock>:`` block. Accesses in ``__init__`` are exempt
+  (the object is not yet shared).
+* ``[shared]`` — the attribute is mutated through non-`self` receivers
+  too (e.g. ``_Endpoint`` state owned by the client's lock): the check
+  widens to every ``<name>.<attr>`` access in the module. Use only for
+  attribute names that are unambiguous within their module.
+* A non-identifier guard (``event-loop``, ``advisory``, ``contextvar``,
+  ...) is DOCUMENTATION ONLY: it records why the attribute needs no
+  lock; nothing is enforced. This keeps the annotation honest for
+  loop-confined or racy-benign-by-design state.
+
+Lock identity is lexical (see `_locks`): helper methods that run with
+the caller's lock held carry a def-line
+``# lint: allow(lock-discipline) — caller holds ...`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from ..core import Finding, Rule, SourceFile
+from ._locks import WithLockTracker
+
+_GUARD_RE = re.compile(r"#\s*guarded by:\s*(\S+)(.*)$")
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+@dataclass
+class GuardDecl:
+    attr: str
+    lock: str
+    shared: bool
+    enforced: bool
+    cls: str
+    line: int
+
+
+def collect_decls(sf: SourceFile) -> dict[str, list[GuardDecl]]:
+    """``self.X = ...`` assignments whose line carries a guard comment,
+    keyed by attribute name (module scope). A list per attribute:
+    distinct classes may legitimately declare the same name with
+    different guards, and overwriting would silently disable the
+    first class's enforcement."""
+    decls: dict[str, list[GuardDecl]] = {}
+    guards: dict[int, tuple[str, bool, bool]] = {}
+    for line, comment in sf.comments.items():
+        m = _GUARD_RE.search(comment)
+        if m is None:
+            continue
+        lock, rest = m.group(1), m.group(2) or ""
+        shared = "[shared]" in rest
+        guards[line] = (lock, shared, bool(_IDENT_RE.match(lock)))
+
+    class _V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.cls: list[str] = []
+
+        def visit_ClassDef(self, node: ast.ClassDef) -> None:
+            self.cls.append(node.name)
+            self.generic_visit(node)
+            self.cls.pop()
+
+        def _decl(self, target: ast.expr, line: int) -> None:
+            g = guards.get(line)
+            if g is None or not self.cls:
+                return
+            if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
+                if target.value.id == "self":
+                    lock, shared, enforced = g
+                    decls.setdefault(target.attr, []).append(
+                        GuardDecl(target.attr, lock, shared, enforced, self.cls[-1], line)
+                    )
+
+        def visit_Assign(self, node: ast.Assign) -> None:
+            for t in node.targets:
+                self._decl(t, node.lineno)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+            self._decl(node.target, node.lineno)
+            self.generic_visit(node)
+
+    if sf.tree is not None:
+        _V().visit(sf.tree)
+    return decls
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "attributes annotated '# guarded by: <lock>' must only be "
+        "read/written inside a 'with ...<lock>:' block"
+    )
+
+    def check(self, sf: SourceFile):
+        decls = collect_decls(sf)
+        if not any(d.enforced for ds in decls.values() for d in ds):
+            return []
+        findings: list[Finding] = []
+
+        # [shared] widens enforcement module-wide by NAME; if another
+        # class declares the same attribute under a different guard,
+        # a non-self access cannot be attributed to either declaration
+        for attr, ds in decls.items():
+            if len(ds) > 1 and any(d.shared for d in ds):
+                if len({(d.lock, d.shared, d.enforced) for d in ds}) > 1:
+                    sites = ", ".join(f"{d.cls}:{d.line} ({d.lock})" for d in ds)
+                    findings.append(
+                        Finding(
+                            self.name, sf.path, max(d.line for d in ds),
+                            f"'{attr}' has conflicting guard declarations "
+                            f"[{sites}] — a [shared] guard requires the "
+                            "attribute name to be unambiguous in its module",
+                        )
+                    )
+
+        class _V(WithLockTracker):
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                ds = decls.get(node.attr)
+                if ds and not self.in_init():
+                    is_self = (
+                        isinstance(node.value, ast.Name) and node.value.id == "self"
+                    )
+                    if is_self:
+                        # the receiver's own class's declaration wins;
+                        # otherwise a [shared] decl from another class
+                        # still covers this name
+                        own = [d for d in ds if d.cls == self.current_class()]
+                        applicable = own or [d for d in ds if d.shared]
+                    else:
+                        applicable = [d for d in ds if d.shared]
+                    for d in applicable:
+                        if d.enforced and not self.holds(d.lock):
+                            findings.append(
+                                Finding(
+                                    LockDisciplineRule.name,
+                                    sf.path,
+                                    node.lineno,
+                                    f"'{node.attr}' is guarded by '{d.lock}' "
+                                    f"(declared {d.cls}:{d.line}) but accessed "
+                                    f"outside 'with ...{d.lock}'",
+                                )
+                            )
+                            break
+                self.generic_visit(node)
+
+        _V().visit(sf.tree)
+        return findings

@@ -1,0 +1,95 @@
+"""monotonic-durations: elapsed-time / deadline math never uses the
+wall clock.
+
+``time.time()`` jumps under NTP steps and leap smearing; every duration
+or deadline computed from it is wrong exactly when the machine is
+having a bad day. The rule flags any wall-clock read —
+``time.time()`` through any module alias, or a direct
+``from time import time`` name — that appears inside additive
+arithmetic (``+``/``-``, including augmented assignment) or a
+comparison: that is duration/deadline math and belongs to
+``time.monotonic()`` / ``time.perf_counter()`` /
+``time.monotonic_ns()``.
+
+Pure timestamp uses (logging a wall time, persisting an ``at:`` field,
+scaling to milliseconds) don't match and stay legal. Legitimate
+wall-clock arithmetic — slot math anchored at a protocol
+``genesis_time``, re-applying a persisted cool-off across restarts —
+is suppressed inline with a reason, which is exactly the documentation
+those sites need anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile
+
+
+def _wall_clock_names(tree: ast.Module) -> tuple[set[str], set[str]]:
+    mods: set[str] = set()
+    funcs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    funcs.add(a.asname or "time")
+    return mods, funcs
+
+
+class MonotonicDurationsRule(Rule):
+    name = "monotonic-durations"
+    description = (
+        "no time.time() in +/- arithmetic or comparisons — use "
+        "time.monotonic()/perf_counter() for durations and deadlines"
+    )
+
+    def check(self, sf: SourceFile):
+        mods, funcs = _wall_clock_names(sf.tree)
+        # local `import time` inside functions is caught by the walk too
+        if not mods and not funcs:
+            return []
+        findings: list[Finding] = []
+        flagged: set[int] = set()
+
+        def is_wall_clock(node: ast.AST) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "time"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in mods
+            ):
+                return True
+            return isinstance(fn, ast.Name) and fn.id in funcs
+
+        def flag_calls_in(root: ast.AST) -> None:
+            for sub in ast.walk(root):
+                if is_wall_clock(sub) and id(sub) not in flagged:
+                    flagged.add(id(sub))
+                    findings.append(
+                        Finding(
+                            MonotonicDurationsRule.name, sf.path, sub.lineno,
+                            "wall-clock time.time() used in elapsed-time/"
+                            "deadline math — use time.monotonic() or "
+                            "perf_counter() (NTP steps corrupt durations)",
+                        )
+                    )
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Sub)):
+                flag_calls_in(node.left)
+                flag_calls_in(node.right)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.op, (ast.Add, ast.Sub)
+            ):
+                flag_calls_in(node.value)
+            elif isinstance(node, ast.Compare):
+                flag_calls_in(node)
+        return findings

@@ -1,0 +1,78 @@
+"""span-discipline: spans are context-managed, never left dangling.
+
+A ``....span(...)`` call or a ``Span(...)`` construction must be the
+context expression of a ``with`` statement — a span entered any other
+way never records its end and silently corrupts the trace it belongs
+to (the cross-thread escape hatch is ``tracing.record(...)``, which
+takes explicit start/end timestamps and is always safe).
+
+One structural exemption: ``return ....span(...)`` inside a function
+itself named ``span`` or ``root`` is a delegating wrapper (the module
+facade handing out the tracer's context manager for the caller to
+``with``). The tracer's internal ``Span(...)`` constructions carry
+explicit pragmas instead — they are the implementation, and the
+reasons belong next to the code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile
+
+_WRAPPER_NAMES = {"span", "root"}
+
+
+class SpanDisciplineRule(Rule):
+    name = "span-discipline"
+    description = ".span(...) / Span(...) only as 'with' context managers"
+
+    def check(self, sf: SourceFile):
+        findings: list[Finding] = []
+        with_ctx: set[int] = set()
+        returned_by: dict[int, str] = {}
+        func_stack: list[str] = []
+
+        class _V(ast.NodeVisitor):
+            def _with(self, node) -> None:
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        with_ctx.add(id(item.context_expr))
+                self.generic_visit(node)
+
+            visit_With = _with
+            visit_AsyncWith = _with
+
+            def _func(self, node) -> None:
+                func_stack.append(node.name)
+                self.generic_visit(node)
+                func_stack.pop()
+
+            visit_FunctionDef = _func
+            visit_AsyncFunctionDef = _func
+
+            def visit_Return(self, node: ast.Return) -> None:
+                if isinstance(node.value, ast.Call) and func_stack:
+                    returned_by[id(node.value)] = func_stack[-1]
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                fn = node.func
+                is_span = (
+                    isinstance(fn, ast.Attribute) and fn.attr == "span"
+                ) or (isinstance(fn, ast.Name) and fn.id == "Span")
+                if is_span and id(node) not in with_ctx:
+                    if returned_by.get(id(node)) not in _WRAPPER_NAMES:
+                        what = "Span(...)" if isinstance(fn, ast.Name) else ".span(...)"
+                        findings.append(
+                            Finding(
+                                SpanDisciplineRule.name, sf.path, node.lineno,
+                                f"{what} outside a 'with' statement — the span "
+                                "never ends; use 'with ... as sp:' or "
+                                "tracing.record() for pre-timed spans",
+                            )
+                        )
+                self.generic_visit(node)
+
+        _V().visit(sf.tree)
+        return findings

@@ -1,0 +1,360 @@
+"""metrics-and-cli-wiring: registered metrics reach a dashboard, CLI
+flags reach code, node options reach the node — both directions.
+
+Project-scoped (inputs are fixed repo locations, independent of the
+path arguments):
+
+1. **dashboards → registry**: every metric-shaped token in a
+   ``dashboards/*.json`` panel expr must be a sample name derivable
+   from a registered family. Sample-name derivation generalizes the
+   ``_total`` handling that bit PR 1 and PR 4: prometheus_client
+   exposes a Counter named ``foo`` (or ``foo_total``) as ``foo_total``,
+   a Histogram ``h`` as ``h_bucket``/``h_sum``/``h_count``, a Summary
+   ``s`` as ``s``/``s_sum``/``s_count``, a Gauge verbatim.
+2. **registry → dashboards**: every registered ``lodestar_*`` family
+   must have at least one panel expr referencing one of its sample
+   names, or an entry in ``UNPANELLED_ALLOWLIST`` with a reason — an
+   unpanelled family is a blind spot during exactly the incident it
+   was registered for. Allowlist entries naming no registered family
+   are flagged as stale (same doctrine as unused pragmas).
+3. **CLI two-way**: every ``--flag`` declared in ``lodestar_tpu/cli.py``
+   is consumed (some ``args.<dest>`` read), and every ``args.<dest>``
+   read has a declaring flag.
+4. **node options two-way**: every ``self.X`` stored by
+   ``BeaconNodeOptions.__init__`` is read as ``opts.X`` somewhere in
+   ``lodestar_tpu/node/__init__.py``, and vice versa — the class of
+   bug where a flag parses, stores, and then silently does nothing.
+
+Metric families are collected statically: ``.counter("name", ...)`` /
+``.gauge(...)`` / ``.histogram(...)`` calls with a literal first
+argument anywhere under ``lodestar_tpu/`` (this is how every family in
+the repo is declared — `RegistryMetricCreator` and the validator
+monitor both go through these methods).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from ..core import Finding, Rule
+
+#: lodestar_* families intentionally not panelled yet; every entry
+#: carries the reason an operator doesn't need it on a dashboard today.
+UNPANELLED_ALLOWLIST: dict[str, str] = {
+    # reference-taxonomy placeholders: the device pipeline has no
+    # worker dispatch/result transfer legs instrumented (the trace
+    # spans bls_device_launch/bls_buffer_wait carry this decomposition)
+    "lodestar_bls_thread_pool_latency_to_worker": "reference-parity placeholder; device pipeline has no worker transfer legs",
+    "lodestar_bls_thread_pool_latency_from_worker": "reference-parity placeholder; device pipeline has no worker transfer legs",
+    # gossipsub router internals: debug-level detail consumed via logs /
+    # ad-hoc queries, not incident dashboards
+    "lodestar_gossip_mesh_peers_by_type_count": "gossipsub router debug detail",
+    "lodestar_gossip_mesh_graft_total": "gossipsub router debug detail",
+    "lodestar_gossip_mesh_prune_total": "gossipsub router debug detail",
+    "lodestar_gossip_ihave_sent_total": "gossipsub router debug detail",
+    "lodestar_gossip_iwant_received_total": "gossipsub router debug detail",
+    "lodestar_gossip_iwant_served_total": "gossipsub router debug detail",
+    "lodestar_gossip_mcache_size": "gossipsub router debug detail",
+    "lodestar_gossip_score_by_topic": "gossipsub router debug detail",
+    "lodestar_gossip_flood_publish_total": "gossipsub router debug detail",
+    "lodestar_gossip_graft_backoff_violations_total": "gossipsub router debug detail",
+    # peer-ops niche detail (the networking + internals dashboards carry
+    # the headline peer health already)
+    "lodestar_app_peer_score": "peer-scoring debug histogram; headline peer health is panelled",
+    "lodestar_peers_report_peer_count_total": "peer-scoring debug detail",
+    "lodestar_peer_goodbye_sent_total": "peer-ops debug detail",
+    "lodestar_peer_goodbye_received_total": "peer-ops debug detail",
+    "lodestar_peers_long_lived_attnets_count": "subnet-subscription debug detail",
+    # discovery debug
+    "lodestar_discv5_active_sessions_count": "discv5 debug detail",
+    "lodestar_discv5_findnode_sent_total": "discv5 debug detail",
+    "lodestar_discv5_discovered_enrs_total": "discv5 debug detail",
+    "lodestar_sync_peers_by_status_count": "sync-peer classification debug detail",
+    # light-client serving counters: no LC dashboard yet
+    "lodestar_light_client_updates_served_total": "light-client serving; no LC dashboard yet",
+    "lodestar_light_client_bootstraps_served_total": "light-client serving; no LC dashboard yet",
+    # execution layer is a stub in this reproduction — panels would
+    # graph constants until a real engine/builder is wired
+    "lodestar_eth1_latest_block_number": "execution layer stubbed in this repro",
+    "lodestar_eth1_deposit_events_total": "execution layer stubbed in this repro",
+    "lodestar_eth1_requests_total": "execution layer stubbed in this repro",
+    "lodestar_execution_engine_requests_total": "execution layer stubbed in this repro",
+    "lodestar_execution_engine_request_seconds": "execution layer stubbed in this repro",
+    "lodestar_builder_requests_total": "execution layer stubbed in this repro",
+    "lodestar_builder_circuit_breaker_open": "execution layer stubbed in this repro",
+}
+
+#: PromQL functions/keywords that survive the identifier regex (the
+#: old tests/metrics/test_dashboards.py list, kept verbatim)
+_PROMQL_WORDS = {
+    "histogram_quantile",
+    "label_replace",
+    "label_join",
+    "group_left",
+    "group_right",
+    "count_values",
+}
+
+_TOKEN_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+_METRIC_METHODS = {"counter", "gauge", "histogram", "summary"}
+
+
+@dataclass(frozen=True)
+class Family:
+    name: str
+    kind: str  # counter | gauge | histogram | summary
+    path: str
+    line: int
+
+    def samples(self) -> frozenset:
+        """Sample names prometheus_client exposes for this family."""
+        if self.kind == "counter":
+            base = self.name[:-6] if self.name.endswith("_total") else self.name
+            return frozenset({base + "_total"})
+        if self.kind == "histogram":
+            return frozenset(
+                {self.name + "_bucket", self.name + "_sum", self.name + "_count"}
+            )
+        if self.kind == "summary":
+            return frozenset({self.name, self.name + "_sum", self.name + "_count"})
+        return frozenset({self.name})
+
+
+def collect_metric_families(pkg_root: Path, sources=None) -> list[Family]:
+    """`.counter("name", ...)`-style declarations under `pkg_root`.
+    `sources` (resolved-path -> SourceFile) reuses trees analyze()
+    already parsed instead of re-parsing the whole tree."""
+    fams: list[Family] = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        sf = sources.get(str(path.resolve())) if sources else None
+        if sf is not None:
+            if sf.tree is None:
+                continue  # surfaced separately by the parse rule
+            tree = sf.tree
+        else:
+            try:
+                tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+            except SyntaxError:
+                continue  # surfaced separately by the parse rule
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                fams.append(
+                    Family(node.args[0].value, node.func.attr, str(path), node.lineno)
+                )
+    return fams
+
+
+def _allowlist_line(name: str) -> int:
+    """Line of `name`'s UNPANELLED_ALLOWLIST entry in this module, so a
+    stale-entry finding points at the line to delete."""
+    for i, line in enumerate(Path(__file__).read_text(encoding="utf-8").splitlines(), 1):
+        if f'"{name}"' in line:
+            return i
+    return 1
+
+
+def dashboard_tokens(dash_dir: Path) -> dict[str, set]:
+    out: dict[str, set] = {}
+    for path in sorted(dash_dir.glob("*.json")):
+        tokens: set = set()
+        dash = json.loads(path.read_text(encoding="utf-8"))
+        for panel in dash.get("panels", []):
+            for target in panel.get("targets", []):
+                for tok in _TOKEN_RE.findall(target.get("expr", "")):
+                    if "_" in tok and tok not in _PROMQL_WORDS:
+                        tokens.add(tok)
+        out[str(path)] = tokens
+    return out
+
+
+def _cli_flags(tree: ast.Module) -> dict[str, tuple[int, str]]:
+    """dest -> (line, flag spelling) for every add_argument('--x', ...)
+    and add_subparsers(dest=...)."""
+    flags: dict[str, tuple[int, str]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr == "add_argument":
+            opt = None
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    if a.value.startswith("--"):
+                        opt = a.value
+                        break
+            if opt is None:
+                continue
+            dest = opt[2:].replace("-", "_")
+            for kw in node.keywords:
+                if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                    dest = kw.value.value
+            flags.setdefault(dest, (node.lineno, opt))
+        elif node.func.attr == "add_subparsers":
+            for kw in node.keywords:
+                if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                    flags.setdefault(kw.value.value, (node.lineno, f"subcommand dest {kw.value.value!r}"))
+    return flags
+
+
+def _attr_reads(tree: ast.Module, receiver: str) -> dict[str, int]:
+    """attr -> first line, for every `receiver.attr` access."""
+    reads: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == receiver
+        ):
+            reads.setdefault(node.attr, node.lineno)
+    return reads
+
+
+def _options_stored(tree: ast.Module, class_name: str) -> dict[str, int]:
+    stored: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            for fn in node.body:
+                if isinstance(fn, ast.FunctionDef) and fn.name == "__init__":
+                    for sub in ast.walk(fn):
+                        targets = []
+                        if isinstance(sub, ast.Assign):
+                            targets = sub.targets
+                        elif isinstance(sub, ast.AnnAssign):
+                            targets = [sub.target]
+                        for t in targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                            ):
+                                stored.setdefault(t.attr, t.lineno)
+    return stored
+
+
+class MetricsCliWiringRule(Rule):
+    name = "metrics-and-cli-wiring"
+    description = (
+        "metric families reach dashboards (with _total/_bucket sample "
+        "derivation), CLI flags and node options are consumed, both ways"
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        findings: list[Finding] = []
+        pkg = repo_root / "lodestar_tpu"
+        dash_dir = repo_root / "dashboards"
+
+        # -- metrics <-> dashboards ---------------------------------------
+        if pkg.is_dir() and dash_dir.is_dir():
+            fams = collect_metric_families(pkg, sources=sources)
+            sample_names: set = set()
+            for fam in fams:
+                sample_names.update(fam.samples())
+            per_dash = dashboard_tokens(dash_dir)
+            all_tokens: set = set().union(*per_dash.values()) if per_dash else set()
+
+            for dpath, tokens in per_dash.items():
+                for tok in sorted(tokens - sample_names):
+                    findings.append(
+                        Finding(
+                            self.name, dpath, 1,
+                            f"panel expr references '{tok}' which no "
+                            "registered metric family can expose "
+                            "(counters surface as <name>_total, "
+                            "histograms as _bucket/_sum/_count)",
+                        )
+                    )
+            seen: set = set()
+            for fam in fams:
+                if not fam.name.startswith("lodestar_") or fam.name in seen:
+                    continue
+                seen.add(fam.name)
+                if fam.name in UNPANELLED_ALLOWLIST:
+                    continue
+                if not (fam.samples() & all_tokens):
+                    findings.append(
+                        Finding(
+                            self.name, fam.path, fam.line,
+                            f"metric family '{fam.name}' ({fam.kind}) is on "
+                            "no dashboard — add a panel or an "
+                            "UNPANELLED_ALLOWLIST entry with a reason",
+                        )
+                    )
+            # allowlist staleness — same doctrine as stale pragmas: an
+            # entry naming no registered family is a standing license
+            # for a future same-named metric to skip the panel check
+            registered = {f.name for f in fams}
+            for name in sorted(UNPANELLED_ALLOWLIST):
+                if name not in registered:
+                    findings.append(
+                        Finding(
+                            self.name, __file__, _allowlist_line(name),
+                            f"UNPANELLED_ALLOWLIST entry '{name}' names no "
+                            "registered metric family — remove the stale "
+                            "entry",
+                        )
+                    )
+
+        # -- CLI flags <-> consumption ------------------------------------
+        cli = pkg / "cli.py"
+        if cli.is_file():
+            tree = ast.parse(cli.read_text(encoding="utf-8"), filename=str(cli))
+            flags = _cli_flags(tree)
+            reads = _attr_reads(tree, "args")
+            for dest, (line, opt) in sorted(flags.items()):
+                if dest not in reads:
+                    findings.append(
+                        Finding(
+                            self.name, str(cli), line,
+                            f"CLI flag {opt} (dest '{dest}') is declared but "
+                            "never consumed — wire it through or drop it",
+                        )
+                    )
+            for attr, line in sorted(reads.items()):
+                if attr not in flags:
+                    findings.append(
+                        Finding(
+                            self.name, str(cli), line,
+                            f"args.{attr} is consumed but no CLI flag "
+                            "declares that dest",
+                        )
+                    )
+
+        # -- node options <-> consumption ---------------------------------
+        node_mod = pkg / "node" / "__init__.py"
+        if node_mod.is_file():
+            tree = ast.parse(node_mod.read_text(encoding="utf-8"), filename=str(node_mod))
+            stored = _options_stored(tree, "BeaconNodeOptions")
+            reads = _attr_reads(tree, "opts")
+            for attr, line in sorted(stored.items()):
+                if attr not in reads:
+                    findings.append(
+                        Finding(
+                            self.name, str(node_mod), line,
+                            f"BeaconNodeOptions.{attr} is stored but the node "
+                            f"never reads opts.{attr} — the option silently "
+                            "does nothing",
+                        )
+                    )
+            for attr, line in sorted(reads.items()):
+                if attr not in stored:
+                    findings.append(
+                        Finding(
+                            self.name, str(node_mod), line,
+                            f"node reads opts.{attr} but BeaconNodeOptions "
+                            "never stores it",
+                        )
+                    )
+        return findings

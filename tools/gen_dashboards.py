@@ -281,6 +281,7 @@ def all_dashboards():
         ("lodestar_sched_occupancy.json", sched_dashboard()),
         ("lodestar_offload_resilience.json", resilience_dashboard()),
         ("lodestar_offload_audit.json", audit_dashboard()),
+        ("lodestar_node_internals.json", node_internals_dashboard()),
     )
 
 
@@ -289,7 +290,10 @@ def main(out: str = OUT):
     for name, dash in all_dashboards():
         path = os.path.join(out, name)
         with open(path, "w") as f:
-            json.dump(dash, f, indent=2)
+            # sort_keys keeps the output byte-stable across dict-build
+            # order changes, so the static-analysis metrics rule (and
+            # the regen-is-noop test) can diff dashboards exactly
+            json.dump(dash, f, indent=2, sort_keys=True)
             f.write("\n")
         print(f"wrote {path}")
 
@@ -689,6 +693,108 @@ def audit_dashboard():
         "Lodestar TPU - Offload Byzantine audit",
         ps,
         ["lodestar", "audit"],
+    )
+
+
+def node_internals_dashboard():
+    """Node internals (chain/process/peer detail): the registered
+    families that belong on a dashboard but fit none of the
+    subsystem-specific ones. Kept two-way-consistent with the registry
+    by the static-analysis metrics rule (tools/analysis)."""
+    ps = [
+        panel(
+            "Block import / production p95",
+            [
+                ("histogram_quantile(0.95, rate(lodestar_block_processor_import_seconds_bucket[5m]))", "import p95"),
+                ("histogram_quantile(0.95, rate(lodestar_block_production_seconds_bucket[5m]))", "production p95"),
+            ],
+            unit="s", pid=1,
+        ),
+        panel(
+            "Import outcomes",
+            [
+                ("sum by (source) (rate(lodestar_blocks_imported_total[5m]))", "imported {{source}}"),
+                ("sum by (reason) (rate(lodestar_blocks_rejected_total[5m]))", "rejected {{reason}}"),
+                ("rate(lodestar_attestations_imported_total[5m])", "attestations"),
+            ],
+            unit="ops", x=12, pid=2,
+        ),
+        panel(
+            "Gossip validation verdicts",
+            [
+                ("sum by (topic) (rate(lodestar_gossip_validation_accept_total[5m]))", "accept {{topic}}"),
+                ("sum by (topic) (rate(lodestar_gossip_validation_reject_total[5m]))", "reject {{topic}}"),
+            ],
+            unit="ops", y=8, pid=3,
+        ),
+        panel(
+            "Event loop lag",
+            [
+                ("histogram_quantile(0.5, rate(lodestar_event_loop_lag_seconds_bucket[5m]))", "p50"),
+                ("histogram_quantile(0.95, rate(lodestar_event_loop_lag_seconds_bucket[5m]))", "p95"),
+            ],
+            unit="s", x=12, y=8, pid=4,
+        ),
+        panel(
+            "State caches & regen",
+            [
+                ("lodestar_state_cache_size", "hot states"),
+                ("lodestar_cp_state_cache_size", "checkpoint states"),
+                ("lodestar_regen_queue_length", "regen queue"),
+                ("histogram_quantile(0.95, rate(lodestar_regen_fn_call_duration_seconds_bucket[5m]))", "regen p95 (s)"),
+            ],
+            y=16, pid=5,
+        ),
+        panel(
+            "Seen caches",
+            [
+                ("lodestar_seen_cache_attesters_size", "attesters"),
+                ("lodestar_seen_cache_aggregators_size", "aggregators"),
+            ],
+            x=12, y=16, pid=6,
+        ),
+        panel(
+            "Op pool sizes",
+            [
+                ("lodestar_op_pool_attestation_pool_size", "attestations"),
+                ("lodestar_op_pool_aggregated_attestation_pool_size", "aggregated"),
+                ("lodestar_op_pool_voluntary_exit_pool_size", "exits"),
+                ("lodestar_op_pool_proposer_slashing_pool_size", "proposer slashings"),
+                ("lodestar_op_pool_attester_slashing_pool_size", "attester slashings"),
+                ("lodestar_op_pool_sync_committee_message_pool_size", "sync messages"),
+            ],
+            y=24, pid=7,
+        ),
+        panel(
+            "Peers & dials",
+            [
+                ("lodestar_peers_count", "peers"),
+                ("lodestar_peers_by_client_count", "{{client}}"),
+                ("sum by (reason) (rate(lodestar_peer_disconnects_total[5m]))", "disconnects {{reason}}"),
+                ("rate(lodestar_peers_dial_attempts_total[5m])", "dials"),
+                ("rate(lodestar_peers_dial_success_total[5m])", "dials ok"),
+            ],
+            x=12, y=24, pid=8,
+        ),
+        panel(
+            "Fork choice findHead p95",
+            [("histogram_quantile(0.95, rate(lodestar_fork_choice_find_head_seconds_bucket[5m]))", "p95")],
+            unit="s", y=32, pid=9,
+        ),
+        panel(
+            "Offload client (process view)",
+            [
+                ("lodestar_offload_outstanding_jobs", "outstanding"),
+                ("lodestar_offload_healthy", "healthy bit"),
+            ],
+            x=12, y=32, pid=10,
+        ),
+    ]
+    return dashboard(
+        "lodestar-node-internals",
+        "Lodestar TPU - Node internals",
+        ps,
+        ["lodestar", "node"],
     )
 
 
