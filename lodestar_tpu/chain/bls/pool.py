@@ -368,10 +368,12 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 self.device_breaker.record_failure()
                 self.metrics["batch_retries"] += 1
                 if traced:
+                    self._trace_prep(chunk, t0)
                     self._trace_launch(chunk, t0, len(all_sets), "batch_error")
                 individual.extend(chunk)
                 continue
             if traced:
+                self._trace_prep(chunk, t0)
                 self._trace_launch(chunk, t0, len(all_sets), "batch")
             if ok:
                 self.metrics["batch_sigs_success"] += len(all_sets)
@@ -388,14 +390,43 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                     ok = self._verify_fn(j.sets)
                 self.device_breaker.record_success()
                 if traced:
+                    self._trace_prep([j], t0)
                     self._trace_launch([j], t0, len(j.sets), "single")
                 self._resolve(j, ok)
             except Exception as e:
                 self.device_breaker.record_failure()
                 if traced:
+                    self._trace_prep([j], t0)
                     self._trace_launch([j], t0, len(j.sets), "single_error")
                 if not j.future.done():
                     j.future.get_loop().call_soon_threadsafe(self._reject, j, e)
+
+    @staticmethod
+    def _trace_prep(jobs: list[_Job], launch_start_ns: int) -> None:
+        """`bls_prep` span per traced job: input preparation inside the
+        launch this thread just performed, with the serving layer
+        (device on-chip pipeline vs host native/python) stamped as an
+        attribute — mirroring how `verifier_layer` attributes the verify.
+        The model layer leaves the timing in a thread-local (it runs on
+        this executor thread, below any tracer context); consuming it
+        here keeps untraced launches free of tracer work. Records that
+        predate this launch are discarded: untraced launches (and mock
+        backends layered over earlier real ones) leave stale info on the
+        executor thread, and attributing an old prep's timestamps to this
+        trace would corrupt its span window."""
+        from lodestar_tpu.models.batch_verify import consume_prep_info
+
+        info = consume_prep_info()
+        if info is None or info["end_ns"] < launch_start_ns:
+            return
+        attrs = {"layer": info["layer"], "sets": info["sets"]}
+        if info["rejected"]:
+            attrs["rejected"] = True
+        for j in jobs:
+            if j.trace_parent is not None:
+                tracing.record(
+                    j.trace_parent, "bls_prep", info["start_ns"], info["end_ns"], attrs
+                )
 
     @staticmethod
     def _trace_launch(jobs: list[_Job], start_ns: int, n_sets: int, mode: str) -> None:

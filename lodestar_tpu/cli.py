@@ -55,6 +55,13 @@ def _add_scheduler_args(sp) -> None:
         help="disable the priority-aware device work scheduler (FIFO launches; "
         "debug/comparison only)",
     )
+    sp.add_argument(
+        "--bls-device-prep", choices=["auto", "on", "off"], default="auto",
+        help="run batch-verify input prep (G1/G2 decompression, subgroup "
+        "checks, hash-to-G2) on the device: auto = only when the Pallas "
+        "backend is live, on = always, off = host prep (native C++ / "
+        "python oracle). Device-prep errors fall back to host prep.",
+    )
     from lodestar_tpu.offload.resilience import (
         DEFAULT_FAILURE_THRESHOLD,
         DEFAULT_MAX_RESET_TIMEOUT_S,
@@ -323,6 +330,7 @@ async def _run_dev(args) -> int:
             offload_quarantine_cooloff_s=args.offload_quarantine_sec,
             offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
+            bls_device_prep=args.bls_device_prep,
         ),
         p=p,
         time_fn=lambda: now[0],
@@ -487,6 +495,7 @@ async def _run_beacon(args) -> int:
             offload_quarantine_cooloff_s=args.offload_quarantine_sec,
             offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
+            bls_device_prep=args.bls_device_prep,
         ),
         p=p,
         db=db,

@@ -176,6 +176,12 @@ def g1_mul_raw(pt, k: int):
 
 
 def g1_in_subgroup(pt) -> bool:
+    """φ-eigenvalue subgroup membership (order-R ladder retained as
+    g1_in_subgroup_order_check for differential tests)."""
+    return g1_in_subgroup_fast(pt)
+
+
+def g1_in_subgroup_order_check(pt) -> bool:
     return g1_is_on_curve(pt) and g1_mul_raw(pt, R) is None
 
 
@@ -323,6 +329,37 @@ def g2_clear_cofactor_fast(pt):
     return g2_add(t3, g2_neg(pt))  # - P
 
 
+# --- phi endomorphism (G1) ---------------------------------------------------
+# GLV endomorphism phi(x, y) = (beta*x, y) with beta a primitive cube root
+# of unity in Fp. For THIS beta (2^((p-1)/3); the other root gives the
+# conjugate eigenvalue x^2 - 1), phi acts on G1 as multiplication by
+# lambda = -x^2 mod r — asserted against the generator below. Subgroup
+# test per Scott (eprint 2021/1130, the check blst/zkcrypto ship): a point
+# on the curve is in G1 iff phi(P) == -[x^2]P, replacing the 255-bit
+# order ladder with a 127-bit one.
+
+BETA_G1 = pow(2, (P - 1) // 3, P)
+assert BETA_G1 != 1 and pow(BETA_G1, 3, P) == 1
+BLS_X2 = BLS_X * BLS_X  # x^2 = |eigenvalue| of -phi (positive)
+
+
+def g1_phi(pt):
+    if pt is None:
+        return None
+    return (BETA_G1 * pt[0] % P, pt[1])
+
+
+def g1_in_subgroup_fast(pt) -> bool:
+    """phi-eigenvalue check: P on the curve is in G1 iff phi(P) == -[x^2]P
+    (pinned against the order-R check in the differential tests; the
+    eigenvalue itself is asserted at import)."""
+    if pt is None:
+        return True
+    if not g1_is_on_curve(pt):
+        return False
+    return g1_eq(g1_phi(pt), g1_neg(g1_mul_raw(pt, BLS_X2)))
+
+
 def g2_in_subgroup_fast(pt) -> bool:
     """[x]-eigenvalue check: P on the twist is in G2 iff psi(P) == [x]P
     (pinned against the order-R check in the differential tests; the
@@ -337,6 +374,10 @@ def g2_in_subgroup_fast(pt) -> bool:
 # import-time self-checks pinning the psi constants to the slow paths
 assert g2_eq(g2_psi(G2_GEN), g2_mul_raw(G2_GEN, BLS_X))  # eigenvalue = x
 assert g2_in_subgroup_fast(g2_mul_raw(G2_GEN, 12345))
+
+# import-time self-checks pinning the phi eigenvalue and the fast G1 check
+assert g1_eq(g1_phi(G1_GEN), g1_mul(G1_GEN, (-BLS_X2) % R))  # eigenvalue = -x^2
+assert g1_in_subgroup_fast(g1_mul_raw(G1_GEN, 12345))
 
 
 assert g1_is_on_curve(G1_GEN), "G1 generator not on curve"

@@ -28,6 +28,7 @@ from .validator_monitor import ValidatorMonitor
 __all__ = [
     "RegistryMetricCreator",
     "BeaconMetrics",
+    "BlsPrepMetrics",
     "TraceMetrics",
     "SchedulerMetrics",
     "ResilienceMetrics",
@@ -314,6 +315,20 @@ class AuditMetrics:
 
 
 @dataclass
+class BlsPrepMetrics:
+    """lodestar_bls_prep_* — batch-verify input preparation
+    (`models/batch_verify.py` prep modes, `ops/prep.py` device stages):
+    sets prepared per layer (device on-chip pipeline vs host
+    native/python), prep wall time, device→host fallbacks and
+    structurally-rejected batches."""
+
+    sets: Counter  # sets prepared, labeled by layer (device/host)
+    seconds: Histogram  # per-call prep wall time, labeled by layer
+    fallbacks: Counter  # device-prep errors degraded to host prep
+    rejected: Counter  # prep calls that rejected a structurally invalid batch
+
+
+@dataclass
 class TraceMetrics:
     """lodestar_trace_* — span-duration summaries derived from the
     per-slot pipeline tracer (`lodestar_tpu/tracing`): every completed
@@ -330,6 +345,7 @@ class TraceMetrics:
 class BeaconMetrics:
     creator: RegistryMetricCreator
     bls_pool: BlsPoolMetrics
+    bls_prep: "BlsPrepMetrics"
     state_transition: StateTransitionMetrics
     gossip: GossipMetrics
     fork_choice: ForkChoiceMetrics
@@ -399,6 +415,27 @@ def create_metrics() -> BeaconMetrics:
         ),
         latency_from_device=c.histogram(
             "lodestar_bls_thread_pool_latency_from_worker", "Result latency", _SEC_TINY,
+        ),
+    )
+    bls_prep = BlsPrepMetrics(
+        sets=c.counter(
+            "lodestar_bls_prep_sets_total",
+            "Signature sets prepared (decompress + subgroup + hash-to-G2), by layer",
+            ["layer"],
+        ),
+        seconds=c.histogram(
+            "lodestar_bls_prep_seconds",
+            "Input-prep wall time per batch, by layer (device/host)",
+            _SEC_SMALL,
+            ["layer"],
+        ),
+        fallbacks=c.counter(
+            "lodestar_bls_prep_fallback_total",
+            "Device input-prep errors degraded to the host prep path",
+        ),
+        rejected=c.counter(
+            "lodestar_bls_prep_rejected_total",
+            "Prep calls that rejected a structurally invalid batch",
         ),
     )
     st = StateTransitionMetrics(
@@ -810,6 +847,7 @@ def create_metrics() -> BeaconMetrics:
     return BeaconMetrics(
         creator=c,
         bls_pool=bls,
+        bls_prep=bls_prep,
         state_transition=st,
         gossip=gossip,
         fork_choice=fc,

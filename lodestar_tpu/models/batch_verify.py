@@ -29,6 +29,8 @@ coefficient is 1, as in the oracle.
 from __future__ import annotations
 
 import os
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +48,11 @@ from lodestar_tpu.ops import tower as tw
 
 __all__ = [
     "COEFF_BITS",
+    "configure_device_prep",
+    "consume_prep_info",
+    "device_prep_active",
     "prepare_sets",
+    "prepare_sets_device",
     "build_device_inputs",
     "device_batch_verify",
     "device_batch_verify_many",
@@ -57,6 +63,84 @@ __all__ = [
 ]
 
 COEFF_BITS = 64  # blinding scalar width, matches blst's 64-bit rand coeffs
+
+# --- device input prep (ops/prep.py) -----------------------------------------
+# Mode knob wired from --bls-device-prep: "auto" runs the on-chip prep
+# pipeline only when the Pallas backend is live (a CPU XLA prep would
+# just be a slower host prep), "on" forces it (tests, benches), "off"
+# keeps the host path (native C++ / python oracle). The host path stays
+# the verified fallback: a device-prep ERROR falls back per the same
+# degradation doctrine as BLS verify (errors degrade, verdicts — incl.
+# "structurally invalid set" — are final).
+PREP_MODES = ("auto", "on", "off")
+_prep_mode = "auto"  # guarded by: GIL (single str slot, set at node init / bench setup)
+_prep_metrics = None  # guarded by: GIL (set once at node init)
+_prep_tls = threading.local()  # per-executor-thread prep span info
+
+
+def configure_device_prep(mode: str | None = None, metrics=None) -> str:
+    """Set the process-wide prep mode and/or the lodestar_bls_prep_*
+    metric family (node init; tests/benches flip the mode around calls).
+    Returns the PREVIOUS mode so callers can save/restore."""
+    global _prep_mode, _prep_metrics
+    prev = _prep_mode
+    if mode is not None:
+        if mode not in PREP_MODES:
+            raise ValueError(f"bls_device_prep must be one of {PREP_MODES}, got {mode!r}")
+        _prep_mode = mode
+    if metrics is not None:
+        _prep_metrics = metrics
+    return prev
+
+
+def device_prep_active(mode: str | None = None) -> bool:
+    """Resolve a prep mode ("auto" follows the Pallas backend)."""
+    mode = mode or _prep_mode
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    from lodestar_tpu.ops import fp_pallas
+
+    return fp_pallas.use_pallas()
+
+
+def consume_prep_info():
+    """Pop the calling thread's last prep record (layer/sets/timing) —
+    the pool reads this after a verify launch to emit the `bls_prep`
+    span without threading a tracer through the model layer."""
+    info = getattr(_prep_tls, "info", None)
+    _prep_tls.info = None
+    return info
+
+
+def _note_prep(layer: str, n_sets: int, t0_ns: int, rejected: bool = False) -> None:
+    end_ns = time.monotonic_ns()
+    _prep_tls.info = {
+        "layer": layer,
+        "sets": n_sets,
+        "start_ns": t0_ns,
+        "end_ns": end_ns,
+        "rejected": rejected,
+    }
+    m = _prep_metrics
+    if m is not None:
+        m.sets.labels(layer).inc(n_sets)
+        m.seconds.labels(layer).observe((end_ns - t0_ns) / 1e9)
+        if rejected:
+            m.rejected.inc()
+
+
+def _note_prep_fallback(err: Exception) -> None:
+    m = _prep_metrics
+    if m is not None:
+        m.fallbacks.inc()
+    from lodestar_tpu.logger import get_logger
+
+    get_logger(name="lodestar.bls-prep").warn(
+        "device input prep failed, falling back to host prep",
+        {"error": str(err)[:120]},
+    )
 
 # sharded-program executables are compiled once per (mesh, batch) with
 # the persistent cache disabled — see device_batch_verify_sharded
@@ -146,6 +230,69 @@ def prepare_sets(sets: list[SignatureSet]):
         _g1_batch_host(pk_pts),
         _g2_batch_host(h_pts),
         _g2_batch_host(sig_pts),
+    )
+
+
+def _prepare_sets_device_arrays(sets: list[SignatureSet], size: int):
+    """Device-resident prep on arrays padded to `size` (one compiled
+    program per size class, same bucketing as the verify stages).
+
+    Host work is byte-oriented only (flag parsing, limb unpacking,
+    expand_message_xmd); every field op — decompression sqrt, subgroup
+    checks, hash-to-field reduction, SSWU/isogeny/cofactor — runs in the
+    staged device programs of ops/prep.py. Returns (pk, h, sig, ok)
+    where ok is the all-sets-structurally-valid verdict (host bool)."""
+    from lodestar_tpu.ops import prep as dp
+
+    n = len(sets)
+    if any(len(bytes(s.pubkey)) != 48 or len(bytes(s.signature)) != 96 for s in sets):
+        # wrong-length encodings are a structural reject, not a device
+        # error — don't burn a host-fallback on garbage input
+        return None, None, None, False
+    pk_raw = np.frombuffer(
+        b"".join(bytes(s.pubkey) for s in sets), dtype=np.uint8
+    ).reshape(n, 48)
+    sig_raw = np.frombuffer(
+        b"".join(bytes(s.signature) for s in sets), dtype=np.uint8
+    ).reshape(n, 96)
+    msgs = [bytes(s.message) for s in sets]
+
+    pk_limbs, pk_sign, pk_struct = dp.parse_g1_compressed(dp.pad_rows(pk_raw, size))
+    sig_limbs, sig_sign, sig_struct = dp.parse_g2_compressed(dp.pad_rows(sig_raw, size))
+    lo, hi = dp.hash_to_field_limbs(msgs + [msgs[0]] * (size - n))
+
+    pk_x, pk_y, pk_ok = dp.g1_decompress_subgroup(pk_limbs, pk_sign)
+    sig_x, sig_y, sig_ok = dp.g2_decompress_subgroup(sig_limbs, sig_sign)
+    u = dp.mont_from_wide(lo, hi)
+    jac = dp.map_to_g2_jac(u)
+    h_x, h_y = dp.hash_finish(
+        tuple(c[:, 0] for c in jac), tuple(c[:, 1] for c in jac)
+    )
+
+    valid = (
+        pk_struct[:n]
+        & sig_struct[:n]
+        & np.asarray(pk_ok)[:n]
+        & np.asarray(sig_ok)[:n]
+    )
+    return (pk_x, pk_y), (h_x, h_y), (sig_x, sig_y), bool(valid.all())
+
+
+def prepare_sets_device(sets: list[SignatureSet]):
+    """Device-path twin of `prepare_sets`: same contract (device-layout
+    arrays or None if any set is structurally invalid), raw compressed
+    bytes in, no per-set big-int math on the host. Internally padded to
+    the verify size classes so callers share compiled programs."""
+    if not sets:
+        return None
+    n = len(sets)
+    pk, h, sig, ok = _prepare_sets_device_arrays(sets, _pad_pow2(n))
+    if not ok:
+        return None
+    return (
+        (pk[0][:n], pk[1][:n]),
+        (h[0][:n], h[1][:n]),
+        (sig[0][:n], sig[1][:n]),
     )
 
 
@@ -467,8 +614,9 @@ def _warm_sharded_cache_subprocess(n_devices: int, batch: int) -> bool:
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
-    size = max(floor, 1 << (n - 1).bit_length())
-    return size
+    from lodestar_tpu.ops.prep import pad_pow2
+
+    return pad_pow2(n, floor)
 
 
 def _random_coeffs(n: int) -> np.ndarray:
@@ -483,38 +631,65 @@ def _random_coeffs(n: int) -> np.ndarray:
     return out
 
 
-def build_device_inputs(sets: list[SignatureSet], size: int | None = None):
-    """Host precompute + padding: decode/validate/hash N sets and pad the
+def _finish_inputs(pk, h, sig, n: int, size: int):
+    """Fresh blinding bits + padding mask over size-padded point arrays."""
+    coeffs = _random_coeffs(n)
+    bits = np.zeros((size, COEFF_BITS), dtype=np.int32)
+    bits[:n] = _bits_msb(coeffs, COEFF_BITS)
+    mask = np.zeros(size, dtype=bool)
+    mask[:n] = True
+    return pk, h, sig, bits, mask
+
+
+def build_device_inputs(
+    sets: list[SignatureSet], size: int | None = None, prep: str | None = None
+):
+    """Input prep + padding: decode/validate/hash N sets and pad the
     arrays to `size` (default: next power of two >= 8, the size-class
     bucketing that keeps one compiled program per class — the device
     analogue of the reference's <= 128-sets-per-job chunking,
     `multithread/index.ts:34-39`). Returns (pk, h, sig, bits, mask) device
     inputs with fresh blinding coefficients, or None on invalid input.
+
+    `prep` overrides the process-wide device-prep mode for this call
+    (see configure_device_prep). On the device path a prep ERROR falls
+    back to the verified host pipeline (native C++ → python oracle); a
+    structural-invalid verdict is final on whichever layer produced it.
     """
-    prepared = prepare_sets(sets)
-    if prepared is None:
+    if not sets:
         return None
-    (pk_x, pk_y), (h_x, h_y), (sig_x, sig_y) = prepared
     n = len(sets)
     if size is None:
         size = _pad_pow2(n)
     if size < n:
         raise ValueError("pad size smaller than batch")
 
-    def pad1(a):
-        return np.concatenate([a, np.repeat(a[:1], size - n, axis=0)], axis=0) if size != n else a
+    if device_prep_active(prep):
+        t0 = time.monotonic_ns()
+        try:
+            pk, h, sig, ok = _prepare_sets_device_arrays(sets, size)
+        except Exception as e:  # degrade to host prep, never resolve here
+            _note_prep_fallback(e)
+        else:
+            _note_prep("device", n, t0, rejected=not ok)
+            if not ok:
+                return None
+            return _finish_inputs(pk, h, sig, n, size)
 
-    coeffs = _random_coeffs(n)
-    bits = np.zeros((size, COEFF_BITS), dtype=np.int32)
-    bits[:n] = _bits_msb(coeffs, COEFF_BITS)
-    mask = np.zeros(size, dtype=bool)
-    mask[:n] = True
-    return (
-        (pad1(pk_x), pad1(pk_y)),
-        (pad1(h_x), pad1(h_y)),
-        (pad1(sig_x), pad1(sig_y)),
-        bits,
-        mask,
+    t0 = time.monotonic_ns()
+    prepared = prepare_sets(sets)
+    _note_prep("host", n, t0, rejected=prepared is None)
+    if prepared is None:
+        return None
+    (pk_x, pk_y), (h_x, h_y), (sig_x, sig_y) = prepared
+    from lodestar_tpu.ops.prep import pad_rows
+
+    return _finish_inputs(
+        (pad_rows(pk_x, size), pad_rows(pk_y, size)),
+        (pad_rows(h_x, size), pad_rows(h_y, size)),
+        (pad_rows(sig_x, size), pad_rows(sig_y, size)),
+        n,
+        size,
     )
 
 

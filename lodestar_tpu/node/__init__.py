@@ -53,6 +53,7 @@ class BeaconNodeOptions:
         offload_quarantine_cooloff_s: float | None = None,
         offload_unquarantine: list[str] | None = None,
         scheduler_enabled: bool = True,
+        bls_device_prep: str = "auto",
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -127,6 +128,18 @@ class BeaconNodeOptions:
         # device work scheduler (lodestar_tpu.scheduler) for the in-process
         # pool; False restores FIFO launches (debug/comparison only)
         self.scheduler_enabled = scheduler_enabled
+        # batch-verify input prep placement (models/batch_verify prep
+        # modes): "auto" runs decompression/subgroup/hash-to-G2 on the
+        # device only when the Pallas backend is live; "on"/"off" force.
+        # Validated against the model layer's canonical mode set (cli.py
+        # keeps a literal copy — argparse choices must not import jax)
+        from lodestar_tpu.models.batch_verify import PREP_MODES
+
+        if bls_device_prep not in PREP_MODES:
+            raise ValueError(
+                f"bls_device_prep must be one of {PREP_MODES}, got {bls_device_prep!r}"
+            )
+        self.bls_device_prep = bls_device_prep
 
 
 class BeaconNode:
@@ -223,6 +236,13 @@ class BeaconNode:
             from lodestar_tpu import tracing as _tracing
 
             _tracing.configure(lag_ms_supplier=lag_sampler.last_lag_ms)
+
+        # 2d. batch-verify input prep placement + lodestar_bls_prep_*
+        # metrics: process-global like the tracer (the prep runs inside
+        # the model layer, below any node object)
+        from lodestar_tpu.models.batch_verify import configure_device_prep
+
+        configure_device_prep(mode=opts.bls_device_prep, metrics=metrics.bls_prep)
 
         # 3. bls verifier — offload endpoints get the resilience stack:
         # breaker-guarded client, then the verified degradation chain

@@ -16,6 +16,8 @@ from lodestar_tpu.crypto.bls import pairing as PR
 from lodestar_tpu.crypto.bls import serdes
 from lodestar_tpu.crypto.bls.hash_to_curve import expand_message_xmd, hash_to_g2
 
+from .rfc9380_vectors import RFC9380_G2_DST, RFC9380_G2_RO_VECTORS
+
 
 def _sk(i: int) -> bls.SecretKey:
     return bls.SecretKey.from_bytes(i.to_bytes(32, "big"))
@@ -154,40 +156,16 @@ class TestHashToG2:
         assert not C.g2_eq(p1, hash_to_g2(b"world"))
 
     # RFC 9380 Appendix J.10.1 — BLS12381G2_XMD:SHA-256_SSWU_RO_ suite
-    # known-answer vectors. Passing these pins the whole pipeline
-    # (expand_message → hash_to_field → SSWU → isogeny → h_eff clearing)
-    # bit-for-bit to the eth2 ciphersuite used by blst in the reference
+    # known-answer vectors (shared fixture rfc9380_vectors.py, also
+    # asserted against the device prep path in tests/ops/test_prep.py).
+    # Passing these pins the whole pipeline (expand_message →
+    # hash_to_field → SSWU → isogeny → h_eff clearing) bit-for-bit to the
+    # eth2 ciphersuite used by blst in the reference
     # (`packages/beacon-node/src/chain/bls/maybeBatch.ts:18`).
-    _RFC_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
 
-    @pytest.mark.parametrize(
-        "msg,px0,px1,py0,py1",
-        [
-            (
-                b"",
-                "0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a",
-                "05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d",
-                "0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92",
-                "12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6",
-            ),
-            (
-                b"abc",
-                "02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbec7780ccc7954725f4168aff2787776e6",
-                "139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4ca3a230ed250fbe3a2acf73a41177fd8",
-                "1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe244aeb197642555a0645fb87bf7466b2ba48",
-                "00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49ac1e1ce70dd94a733534f106d4cec0eddd16",
-            ),
-            (
-                b"abcdef0123456789",
-                "121982811d2491fde9ba7ed31ef9ca474f0e1501297f68c298e9f4c0028add35aea8bb83d53c08cfc007c1e005723cd0",
-                "190d119345b94fbd15497bcba94ecf7db2cbfd1e1fe7da034d26cbba169fb3968288b3fafb265f9ebd380512a71c3f2c",
-                "05571a0f8d3c08d094576981f4a3b8eda0a8e771fcdcc8ecceaf1356a6acf17574518acb506e435b639353c2e14827c8",
-                "0bb5e7572275c567462d91807de765611490205a941a5a6af3b1691bfe596c31225d3aabdf15faff860cb4ef17c7c3be",
-            ),
-        ],
-    )
+    @pytest.mark.parametrize("msg,px0,px1,py0,py1", RFC9380_G2_RO_VECTORS)
     def test_rfc9380_g2_known_answer(self, msg, px0, px1, py0, py1):
-        p = hash_to_g2(msg, self._RFC_DST)
+        p = hash_to_g2(msg, RFC9380_G2_DST)
         assert "%096x" % p[0][0] == px0
         assert "%096x" % p[0][1] == px1
         assert "%096x" % p[1][0] == py0

@@ -102,6 +102,31 @@ def bls_pool():
             ],
             unit="s", x=12, y=8, pid=4,
         ),
+        panel(
+            "Input prep throughput by layer (device vs host)",
+            [
+                ("rate(lodestar_bls_prep_sets_total[1m])", "{{layer}}"),
+            ],
+            unit="ops", x=0, y=16, pid=5,
+        ),
+        panel(
+            "Input prep time by layer",
+            [
+                (
+                    "histogram_quantile(0.95, sum by (le, layer) (rate(lodestar_bls_prep_seconds_bucket[5m])))",
+                    "p95 {{layer}}",
+                ),
+            ],
+            unit="s", x=12, y=16, pid=6,
+        ),
+        panel(
+            "Input prep fallbacks / rejected batches",
+            [
+                ("rate(lodestar_bls_prep_fallback_total[1m])", "device→host fallbacks"),
+                ("rate(lodestar_bls_prep_rejected_total[1m])", "rejected batches"),
+            ],
+            unit="ops", x=0, y=24, pid=7,
+        ),
     ]
     return dashboard("lodestar-bls-pool", "Lodestar TPU - BLS verifier pool", ps, ["lodestar", "bls"])
 
