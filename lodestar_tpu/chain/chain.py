@@ -30,8 +30,10 @@ from lodestar_tpu.scheduler import PriorityClass
 from lodestar_tpu.state_transition import (
     EpochContext,
     compute_epoch_at_slot,
+    drop_tracker,
     process_block,
     process_slots,
+    state_hash_tree_root,
 )
 from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
 from lodestar_tpu.state_transition.util import effective_balances_array
@@ -100,6 +102,11 @@ class StateCache:
         return st
 
     def add(self, block_root: bytes, state) -> None:
+        # a cached state is dormant: every consumer copies before
+        # mutating (and copy() drops the HTR tracker), so its
+        # incremental-root snapshots would be pinned dead weight —
+        # hundreds of MB per state at the 1M-validator target
+        drop_tracker(state)
         self._by_root[block_root] = state
         while len(self._by_root) > self.max_states:
             self._by_root.pop(next(iter(self._by_root)))
@@ -460,7 +467,10 @@ class BeaconChain:
             except (BlockProcessError, StateTransitionError) as e:
                 raise BlockError(BlockErrorCode.INVALID_STATE_TRANSITION, str(e)) from e
             with tracing.span("hash_tree_root", parent=stf_parent):
-                got = post.type.hash_tree_root(post)
+                # the dirty-subtree collector when --htr-device selects
+                # it; the tracker is warm from process_slots on this
+                # same post-state, so only the block's mutations flush
+                got = state_hash_tree_root(post)
             if got != bytes(block.state_root):
                 raise BlockError(BlockErrorCode.INVALID_STATE_TRANSITION, "state root mismatch")
             return post
@@ -656,11 +666,17 @@ class BeaconChain:
         # every descendant between the block's slot and the pad target
         header = st.latest_block_header.copy()
         if bytes(header.state_root) == b"\x00" * 32:
-            header.state_root = st.type.hash_tree_root(st)
+            # transient: rides a tracker left warm by the replay's
+            # process_slots, but never cold-builds one for a dormant
+            # cached state's single root
+            header.state_root = state_hash_tree_root(st, transient=True)
         if (
             int(st.slot) == int(st.latest_block_header.slot)
             and self.types.BeaconBlockHeader.hash_tree_root(header) == root
         ):
             self.state_cache.add(root, st)
+        # the memo state is dormant too (replay consumers copy first):
+        # drop tracking even when the cache-add condition was skipped
+        drop_tracker(st)
         self._finalized_replay_memo = (root, st)
         return st

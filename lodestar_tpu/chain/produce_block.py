@@ -10,7 +10,12 @@ fill in the state root.
 from __future__ import annotations
 
 from lodestar_tpu import tracing
-from lodestar_tpu.state_transition import EpochContext, process_block, process_slots
+from lodestar_tpu.state_transition import (
+    EpochContext,
+    process_block,
+    process_slots,
+    state_hash_tree_root,
+)
 from lodestar_tpu.types import ssz_types
 
 __all__ = ["produce_block", "compute_new_state_root", "dial_to_slot", "make_attestation_data"]
@@ -142,4 +147,6 @@ def compute_new_state_root(chain, dialed_state, block, ctx) -> bytes:
     with tracing.span("produce_stf"):
         process_block(post, block, ctx, verify_signatures=False, cfg=chain.cfg)
     with tracing.span("produce_hash_tree_root"):
-        return post.type.hash_tree_root(post)
+        # transient: `post` is a throwaway clone — never cold-build
+        # tracker snapshots just to discard them with it
+        return state_hash_tree_root(post, transient=True)

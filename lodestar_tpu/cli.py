@@ -62,6 +62,13 @@ def _add_scheduler_args(sp) -> None:
         "backend is live, on = always, off = host prep (native C++ / "
         "python oracle). Device-prep errors fall back to host prep.",
     )
+    sp.add_argument(
+        "--htr-device", choices=["auto", "on", "off"], default="auto",
+        help="flush state hashTreeRoot dirty subtrees through the device "
+        "SHA-256 kernel (one batched launch per tree level): auto = only "
+        "when the Pallas backend is live, on = always, off = CPU "
+        "incremental hashing. Device errors fall back to the CPU path.",
+    )
     from lodestar_tpu.offload.resilience import (
         DEFAULT_FAILURE_THRESHOLD,
         DEFAULT_MAX_RESET_TIMEOUT_S,
@@ -331,6 +338,7 @@ async def _run_dev(args) -> int:
             offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
+            htr_device=args.htr_device,
         ),
         p=p,
         time_fn=lambda: now[0],
@@ -496,6 +504,7 @@ async def _run_beacon(args) -> int:
             offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
+            htr_device=args.htr_device,
         ),
         p=p,
         db=db,

@@ -329,6 +329,21 @@ class BlsPrepMetrics:
 
 
 @dataclass
+class SszHtrMetrics:
+    """lodestar_ssz_htr_* — device hashTreeRoot (`ssz/device_htr.py`
+    collector, `state_transition/htr.py` tracker): dirty-subtree
+    flushes per backend, dirty chunk volume, batched hash launches
+    (the one-per-level invariant's observable), flush wall time, and
+    device→CPU degradations."""
+
+    flushes: Counter  # collector flushes served, labeled by backend (device/cpu)
+    dirty_chunks: Counter  # dirty leaf chunks re-hashed across flushes
+    launches: Counter  # ALL device hash_pairs dispatches (collector flush levels + shared-hook batch levels)
+    seconds: Histogram  # per-flush wall time, labeled by backend
+    fallbacks: Counter  # degradations, by leg (flush: device err → CPU hasher; tracker: bug → value path)
+
+
+@dataclass
 class TraceMetrics:
     """lodestar_trace_* — span-duration summaries derived from the
     per-slot pipeline tracer (`lodestar_tpu/tracing`): every completed
@@ -346,6 +361,7 @@ class BeaconMetrics:
     creator: RegistryMetricCreator
     bls_pool: BlsPoolMetrics
     bls_prep: "BlsPrepMetrics"
+    ssz_htr: "SszHtrMetrics"
     state_transition: StateTransitionMetrics
     gossip: GossipMetrics
     fork_choice: ForkChoiceMetrics
@@ -436,6 +452,34 @@ def create_metrics() -> BeaconMetrics:
         rejected=c.counter(
             "lodestar_bls_prep_rejected_total",
             "Prep calls that rejected a structurally invalid batch",
+        ),
+    )
+    ssz_htr = SszHtrMetrics(
+        flushes=c.counter(
+            "lodestar_ssz_htr_flushes_total",
+            "Dirty-subtree collector flushes, by backend (device/cpu)",
+            ["backend"],
+        ),
+        dirty_chunks=c.counter(
+            "lodestar_ssz_htr_dirty_chunks_total",
+            "Dirty leaf chunks re-hashed by collector flushes",
+        ),
+        launches=c.counter(
+            "lodestar_ssz_htr_launches_total",
+            "Device hash_pairs dispatches issued, counted at the dispatch site "
+            "(collector flush levels plus shared-hook batch levels; the per-flush "
+            "launch-count invariant itself is asserted by tests)",
+        ),
+        seconds=c.histogram(
+            "lodestar_ssz_htr_seconds",
+            "Collector flush wall time, by backend",
+            _SEC_SMALL,
+            ["backend"],
+        ),
+        fallbacks=c.counter(
+            "lodestar_ssz_htr_fallback_total",
+            "HTR degradations, by leg (flush: device error to CPU hasher; tracker: tracker error to value path)",
+            ["leg"],
         ),
     )
     st = StateTransitionMetrics(
@@ -848,6 +892,7 @@ def create_metrics() -> BeaconMetrics:
         creator=c,
         bls_pool=bls,
         bls_prep=bls_prep,
+        ssz_htr=ssz_htr,
         state_transition=st,
         gossip=gossip,
         fork_choice=fc,

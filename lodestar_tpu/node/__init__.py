@@ -54,6 +54,7 @@ class BeaconNodeOptions:
         offload_unquarantine: list[str] | None = None,
         scheduler_enabled: bool = True,
         bls_device_prep: str = "auto",
+        htr_device: str = "auto",
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -140,6 +141,17 @@ class BeaconNodeOptions:
                 f"bls_device_prep must be one of {PREP_MODES}, got {bls_device_prep!r}"
             )
         self.bls_device_prep = bls_device_prep
+        # state hashTreeRoot placement (ssz/device_htr.py collector):
+        # "auto" flushes dirty subtrees through the device SHA-256
+        # kernel only when the Pallas backend is live; "on"/"off" force.
+        # Device errors degrade to the CPU incremental path (counted).
+        from lodestar_tpu.ssz.device_htr import HTR_MODES
+
+        if htr_device not in HTR_MODES:
+            raise ValueError(
+                f"htr_device must be one of {HTR_MODES}, got {htr_device!r}"
+            )
+        self.htr_device = htr_device
 
 
 class BeaconNode:
@@ -243,6 +255,13 @@ class BeaconNode:
         from lodestar_tpu.models.batch_verify import configure_device_prep
 
         configure_device_prep(mode=opts.bls_device_prep, metrics=metrics.bls_prep)
+
+        # 2e. state hashTreeRoot placement + lodestar_ssz_htr_* metrics:
+        # process-global like the prep mode (the collector runs inside
+        # the ssz/state-transition layers, below any node object)
+        from lodestar_tpu.ssz.device_htr import configure_device_htr
+
+        configure_device_htr(mode=opts.htr_device, metrics=metrics.ssz_htr)
 
         # 3. bls verifier — offload endpoints get the resilience stack:
         # breaker-guarded client, then the verified degradation chain

@@ -17,11 +17,20 @@ from __future__ import annotations
 
 import numpy as np
 
-from .hash import hash_nodes
 from .merkle import next_pow_of_two
 from .types import Boolean, ByteVector, Container, Uint
 
 __all__ = ["batch_container_roots", "pack_basic_chunks"]
+
+
+def _level_hash(data):
+    """One merkle level through the shared device/CPU selection hook
+    (`ssz.device_htr.hash_level`) so list merkleization and the dirty
+    collector ride one backend switch. Identical to `hash_nodes` with
+    the device HTR mode off."""
+    from . import device_htr
+
+    return device_htr.hash_level(data)
 
 
 def _field_roots_column(ftype, values, getter) -> np.ndarray | None:
@@ -55,7 +64,7 @@ def _field_roots_column(ftype, values, getter) -> np.ndarray | None:
         chunks = np.zeros((n, 64), dtype=np.uint8)
         buf = b"".join(getter(v) for v in values)
         chunks[:, : ftype.length] = np.frombuffer(buf, dtype=np.uint8).reshape(n, ftype.length)
-        return hash_nodes(chunks.reshape(2 * n, 32))
+        return _level_hash(chunks.reshape(2 * n, 32))
     return None
 
 
@@ -81,7 +90,7 @@ def batch_container_roots(ctype: Container, values) -> np.ndarray | None:
         leaves[:, j, :] = col
     level = leaves.reshape(n * width, 32)
     while width > 1:
-        level = hash_nodes(level)
+        level = _level_hash(level)
         width //= 2
     return level.reshape(n, 32)
 

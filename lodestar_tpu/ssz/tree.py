@@ -57,6 +57,7 @@ __all__ = [
     "leaf",
     "branch",
     "zero_node",
+    "collect_unhashed",
     "compute_root",
     "subtree_from_chunks",
     "get_node",
@@ -105,11 +106,26 @@ def zero_node(depth: int) -> Node:
     return _ZERO_NODES[depth]
 
 
-def compute_root(node: Node) -> bytes:
-    """Root of `node`, hashing every unhashed descendant in height-grouped
-    batches (one `hash_nodes` call per level of dirty frontier)."""
-    if node._root is not None:
-        return node._root
+def _root_routed(node: Node, dirty: int | None = None) -> bytes:
+    """Root of `node` through the process-wide HTR backend switch: the
+    device dirty-subtree collector (`ssz.device_htr`, one padded
+    `hash_pairs` launch per level, errors degrade to CPU) when active,
+    else the host `compute_root` below — the verified fallback path.
+    `dirty` is the view's recorded mutated-chunk count, forwarded for
+    metric attribution."""
+    from . import device_htr
+
+    if device_htr.device_htr_active():
+        return device_htr.compute_root_node(node, dirty_hint=dirty)
+    return compute_root(node)
+
+
+def collect_unhashed(node: Node) -> dict[int, list[Node]]:
+    """Group every unhashed descendant of `node` by dirty-subgraph
+    height (1 = both children rooted). The ONE walk behind both the
+    CPU `compute_root` below and the device collector's node jobs
+    (`ssz.device_htr`) — their launch schedules must stay identical,
+    so the grouping lives in exactly one place."""
     groups: dict[int, list[Node]] = {}
     memo: dict[int, int] = {}
 
@@ -126,6 +142,15 @@ def compute_root(node: Node) -> bytes:
         return h
 
     height(node)
+    return groups
+
+
+def compute_root(node: Node) -> bytes:
+    """Root of `node`, hashing every unhashed descendant in height-grouped
+    batches (one `hash_nodes` call per level of dirty frontier)."""
+    if node._root is not None:
+        return node._root
+    groups = collect_unhashed(node)
     for h in sorted(groups):
         batch = groups[h]
         data = np.empty((2 * len(batch), 32), dtype=np.uint8)
@@ -196,7 +221,15 @@ def _chunk_depth(limit_chunks: int) -> int:
 
 
 class TreeView:
-    """Base: a typed window over a Node subtree."""
+    """Base: a typed window over a Node subtree.
+
+    Views carry cheap dirty tracking: mutated gindices (or field names)
+    are recorded on `set`/`push` into a plain set — no per-node Python
+    object bloat for clean subtrees — and cleared when the view
+    re-roots. `dirty_count()` is forwarded to the collector as the
+    exact mutated-chunk count behind
+    `lodestar_ssz_htr_dirty_chunks_total`; the authoritative dirty
+    structure for hashing stays the unhashed-node frontier."""
 
     def hash_tree_root(self) -> bytes:
         raise NotImplementedError
@@ -207,6 +240,9 @@ class TreeView:
 
     def to_value(self):
         raise NotImplementedError
+
+    def dirty_count(self) -> int:
+        return 0
 
 
 class _LeafView(TreeView):
@@ -237,6 +273,9 @@ class BasicListTreeView(TreeView):
         self.per_chunk = 32 // self.elem_size
         limit_chunks = -(-sszt.limit * self.elem_size // 32)
         self.depth = _chunk_depth(limit_chunks)
+        # mutated chunk gindices since the last re-root (cheap dirty
+        # tracking: one set for the whole view, nothing per clean node)
+        self._dirty: set[int] = set()  # guarded by: view-owner (views are confined to the thread advancing their state)
         if node is not None:
             self._node = node
             self._length = length
@@ -274,6 +313,7 @@ class BasicListTreeView(TreeView):
         chunk = bytearray(get_node(self._node, gi)._root)
         chunk[lane * self.elem_size : (lane + 1) * self.elem_size] = self.type.elem.serialize(v)
         self._node = set_node(self._node, gi, leaf(bytes(chunk)))
+        self._dirty.add(gi)
 
     def push(self, v) -> None:
         if self._length >= self.type.limit:
@@ -285,12 +325,21 @@ class BasicListTreeView(TreeView):
         chunk = bytearray(get_node(self._node, gi)._root if lane else b"\x00" * 32)
         chunk[lane * self.elem_size : (lane + 1) * self.elem_size] = self.type.elem.serialize(v)
         self._node = set_node(self._node, gi, leaf(bytes(chunk)))
+        self._dirty.add(gi)
+
+    def dirty_count(self) -> int:
+        return len(self._dirty)
+
+    def dirty_gindices(self) -> frozenset[int]:
+        return frozenset(self._dirty)
 
     def commit(self) -> Node:
         return self._node
 
     def hash_tree_root(self) -> bytes:
-        return mix_in_length(compute_root(self._node), self._length)
+        root = mix_in_length(_root_routed(self._node, dirty=len(self._dirty)), self._length)
+        self._dirty.clear()
+        return root
 
     def to_value(self):
         return [self.get(i) for i in range(self._length)]
@@ -304,6 +353,7 @@ class CompositeListTreeView(TreeView):
     def __init__(self, sszt: List, values=None, node: Node | None = None, length: int = 0):
         self.type = sszt
         self.depth = _chunk_depth(sszt.limit)
+        self._dirty: set[int] = set()  # guarded by: view-owner (views are confined to the thread advancing their state)
         if node is not None:
             self._node = node
             self._length = length
@@ -340,6 +390,7 @@ class CompositeListTreeView(TreeView):
             raise IndexError("list index out of range")
         gi = (1 << self.depth) + i
         self._node = set_node(self._node, gi, leaf(self.type.elem.hash_tree_root(v)))
+        self._dirty.add(gi)
         if self._values is not None:
             self._values[i] = v
 
@@ -348,15 +399,24 @@ class CompositeListTreeView(TreeView):
             raise ValueError("list limit exceeded")
         gi = (1 << self.depth) + self._length
         self._node = set_node(self._node, gi, leaf(self.type.elem.hash_tree_root(v)))
+        self._dirty.add(gi)
         self._length += 1
         if self._values is not None:
             self._values.append(v)
+
+    def dirty_count(self) -> int:
+        return len(self._dirty)
+
+    def dirty_gindices(self) -> frozenset[int]:
+        return frozenset(self._dirty)
 
     def commit(self) -> Node:
         return self._node
 
     def hash_tree_root(self) -> bytes:
-        return mix_in_length(compute_root(self._node), self._length)
+        root = mix_in_length(_root_routed(self._node, dirty=len(self._dirty)), self._length)
+        self._dirty.clear()
+        return root
 
     def to_value(self):
         if self._values is None:
@@ -378,6 +438,7 @@ class ContainerTreeView(TreeView):
         self._children: dict[str, TreeView] = {}
         self._field_roots: dict[str, bytes] = {}
         self._node: Node | None = None  # built lazily on first root
+        self._dirty_fields: set[str] = set()  # guarded by: view-owner (views are confined to the thread advancing their state)
 
     # -- typed access ---------------------------------------------------------
 
@@ -392,6 +453,7 @@ class ContainerTreeView(TreeView):
         ftype = self.type.fields[idx][1]
         self._children.pop(fname, None)
         self._field_roots[fname] = ftype.hash_tree_root(v)
+        self._dirty_fields.add(fname)
         setattr(self._value, fname, v)
 
     def view(self, fname: str) -> TreeView:
@@ -417,12 +479,22 @@ class ContainerTreeView(TreeView):
             self._field_roots[fname] = r
         return r
 
+    def dirty_count(self) -> int:
+        return len(self._dirty_fields) + sum(
+            c.dirty_count() for c in self._children.values()
+        )
+
     def hash_tree_root(self) -> bytes:
+        # hint = OWN dirty field roots only: each dirty child view
+        # attributes its chunks itself when `_field_root` re-roots it —
+        # folding children in here would double-count the metric
+        dirty = len(self._dirty_fields)
         roots = np.frombuffer(
             b"".join(self._field_root(n, t) for n, t in self.type.fields), dtype=np.uint8
         ).reshape(len(self.type.fields), 32)
         self._node = subtree_from_chunks(roots, self.depth)
-        return compute_root(self._node)
+        self._dirty_fields.clear()
+        return _root_routed(self._node, dirty=dirty)
 
     def commit(self) -> Node:
         self.hash_tree_root()

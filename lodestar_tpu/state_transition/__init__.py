@@ -31,6 +31,7 @@ from .block import (  # noqa: F401
     slash_validator,
 )
 from .cache import EpochContext, EpochShuffling  # noqa: F401
+from .htr import StateRootTracker, drop_tracker, state_hash_tree_root  # noqa: F401
 from .epoch import (  # noqa: F401
     EpochProcess,
     before_process_epoch,
@@ -50,6 +51,9 @@ from .util import (  # noqa: F401
 
 __all__ = [
     "state_transition",
+    "state_hash_tree_root",
+    "drop_tracker",
+    "StateRootTracker",
     "process_slots",
     "process_slot",
     "process_block",
@@ -75,7 +79,10 @@ def process_slot(state, p: BeaconPreset | None = None) -> None:
     root, cache block root."""
     p = p or active_preset()
     t = ssz_types(p)
-    prev_state_root = _state_type(state, p).hash_tree_root(state)
+    # per-slot state root: the dirty-subtree collector when --htr-device
+    # selects it (one batched hash launch per tree level), else the
+    # verified value path (htr.py documents the degradation chain)
+    prev_state_root = state_hash_tree_root(state)
     state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
     if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
         state.latest_block_header.state_root = prev_state_root
@@ -176,7 +183,7 @@ def state_transition(
     process_block(post, block, ctx, verify_signatures, cfg)
 
     if verify_state_root:
-        got = _state_type(post, p).hash_tree_root(post)
+        got = state_hash_tree_root(post)
         if got != bytes(block.state_root):
             raise StateTransitionError(
                 f"state root mismatch: block {bytes(block.state_root).hex()[:16]} != computed {got.hex()[:16]}"

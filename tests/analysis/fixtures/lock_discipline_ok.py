@@ -8,6 +8,7 @@ class Counter:
         self._lock = threading.Lock()
         self._count = 0  # guarded by: _lock
         self._buffered = []  # guarded by: event-loop (single-threaded)
+        self._mode = "auto"  # guarded by: config-time (doc-only: not one of the enforced owner guards)
 
     def bump(self):
         with self._lock:
@@ -17,10 +18,20 @@ class Counter:
         with self._lock:
             return self._count
 
-    def stash(self, item):
-        # documentation-only guard ("event-loop" is not an identifier):
-        # nothing is enforced for _buffered
+    async def stash(self, item):
+        # event-loop is an ENFORCED single-writer guard: writes must sit
+        # in a loop-owned scope — an async def qualifies
         self._buffered.append(item)
+
+    def peek(self):
+        # reads of loop-confined state are unrestricted (stale reads are
+        # the documented-benign part of these annotations)
+        return len(self._buffered)
+
+    def reconfigure(self, mode):
+        # a non-identifier guard OUTSIDE the enforced owner set stays
+        # documentation-only: this write is not flagged
+        self._mode = mode
 
     def snapshot(self):
         with self._lock:

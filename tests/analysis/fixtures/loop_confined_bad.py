@@ -1,0 +1,52 @@
+"""Fixture: loop-confined single-writer guards violated — must flag.
+
+The enforced owner guards (event-loop, audit-thread, probe-thread)
+require every WRITE (store, augassign, in-place mutator) to sit in a
+scope owned by the declared context; these writes don't.
+"""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._buffered = []  # guarded by: event-loop (single-threaded)
+        self._outstanding = 0  # guarded by: event-loop (writers)
+
+    def shed(self):
+        # BAD: public sync method, no owned caller — not loop-owned
+        self._buffered.clear()
+
+    def bump(self):
+        # BAD: augassign write from a non-owned scope
+        self._outstanding += 1
+
+    def stomp(self):
+        # BAD: item assignment is a write (Store lands on the
+        # Subscript, the attribute itself reads as Load)
+        self._buffered[0] = None
+
+    def evict(self):
+        # BAD: item deletion likewise
+        del self._buffered[0]
+
+    async def enqueue(self, job):
+        self._buffered.append(job)  # fine: async def is loop-owned
+
+
+class Prober:
+    def __init__(self):
+        self.failures = 0  # guarded by: probe-thread (single owner)
+        self._thread = threading.Thread(target=self._probe_loop)
+
+    def _probe_loop(self):
+        self.failures += 1  # fine: the thread target owns it
+
+    def reset(self):
+        # BAD: external sync reset races the probe thread's writes
+        self.failures = 0
+
+
+def reset_all(prober):
+    # BAD: owner guards follow the attribute through ANY receiver
+    prober.failures = 0

@@ -1,0 +1,57 @@
+"""Fixture: loop-confined single-writer guards respected — clean.
+
+Exercises the ownership fixpoint: async roots, loop-registered
+callbacks and lambdas, sync helpers reachable only from owned scopes,
+thread targets and their helpers, and unrestricted reads.
+"""
+
+import asyncio
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._buffered = []  # guarded by: event-loop (single-threaded)
+        self._timer = None  # guarded by: event-loop (single-threaded)
+        self._outstanding = 0  # guarded by: event-loop (writers; stale readers tolerated)
+
+    async def enqueue(self, job, fut):
+        self._enqueue(job)
+        fut.add_done_callback(lambda _f: self._dec())
+        loop = asyncio.get_event_loop()
+        self._timer = loop.call_later(0.05, self._flush)
+
+    def _enqueue(self, job):
+        # sync helper: every reference comes from an owned scope
+        self._buffered.append(job)
+        self._outstanding += 1
+
+    def _dec(self):
+        # referenced only from the loop-registered done-callback lambda
+        self._outstanding -= 1
+
+    def _flush(self):
+        # registered with call_later: a loop owner root
+        self._buffered.clear()
+        self._timer = None
+
+    def depth(self):
+        # reads of loop-confined state are unrestricted
+        return len(self._buffered)
+
+
+class Auditor:
+    def __init__(self):
+        self.events = []  # guarded by: audit-thread (single writer)
+        self._thread = threading.Thread(target=self._drain_loop, daemon=True)
+
+    def _drain_loop(self):
+        while True:
+            self._audit_one()
+
+    def _audit_one(self):
+        # helper reachable only from the thread target
+        self.events.append("checked")
+
+    def snapshot(self):
+        return list(self.events)

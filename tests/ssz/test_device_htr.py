@@ -1,0 +1,370 @@
+"""Device hashTreeRoot collector (ssz/device_htr.py): launch-count
+invariant, differential equality against the CPU incremental and
+from-scratch device paths, view dirty tracking, the shared batch
+backend switch, and the device-error → CPU degradation."""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.ssz import device_htr as dh
+from lodestar_tpu.ssz import tree as T
+from lodestar_tpu.ssz.batch import batch_container_roots
+from lodestar_tpu.ssz.hash import hash_nodes_cpu
+from lodestar_tpu.ssz.merkle import merkleize, mix_in_length
+from lodestar_tpu.ssz.types import (
+    Bytes32,
+    Bytes48,
+    Container,
+    ContainerValue,
+    List,
+    boolean,
+    uint64,
+)
+
+
+@pytest.fixture
+def device_on():
+    """Force the device backend AND drop the per-level size floor so
+    small test trees actually dispatch (production keeps the
+    DEVICE_MIN_PAIRS asymmetry; `test_hash_level_small_levels_stay_on_host`
+    pins that)."""
+    prev = dh.configure_device_htr(mode="on")
+    prev_min = dh.DEVICE_MIN_FLUSH_PAIRS
+    dh.DEVICE_MIN_FLUSH_PAIRS = 1
+    yield
+    dh.DEVICE_MIN_FLUSH_PAIRS = prev_min
+    dh.configure_device_htr(mode=prev)
+
+
+class _Counter:
+    def __init__(self):
+        self.n = 0.0
+
+    def labels(self, *a):  # aggregate across legs; tests check the total
+        return self
+
+    def inc(self, amount=1):
+        self.n += amount
+
+
+class _Obs:
+    def __init__(self):
+        self.vals = []
+
+    def observe(self, v):
+        self.vals.append(v)
+
+
+class _Labeled:
+    def __init__(self, leaf_cls):
+        self._leaf_cls = leaf_cls
+        self.by_label = {}
+
+    def labels(self, *labels):
+        return self.by_label.setdefault(labels, self._leaf_cls())
+
+
+class FakeHtrMetrics:
+    def __init__(self):
+        self.flushes = _Labeled(_Counter)
+        self.dirty_chunks = _Counter()
+        self.launches = _Counter()
+        self.seconds = _Labeled(_Obs)
+        self.fallbacks = _Counter()
+
+
+@pytest.fixture
+def htr_metrics():
+    m = FakeHtrMetrics()
+    prev = dh._htr_metrics
+    dh.configure_device_htr(metrics=m)
+    yield m
+    dh._htr_metrics = prev
+
+
+class TestCollectorNodePath:
+    def test_root_matches_cpu_and_merkleize(self, device_on):
+        rng = np.random.default_rng(7)
+        chunks = rng.integers(0, 256, size=(13, 32), dtype=np.uint8)
+        node_dev = T.subtree_from_chunks(chunks, 4)
+        node_cpu = T.subtree_from_chunks(chunks, 4)
+        assert (
+            dh.compute_root_node(node_dev)
+            == T.compute_root(node_cpu)
+            == merkleize(chunks, limit=16)
+        )
+
+    def test_from_scratch_merkle_root_device_agrees(self, device_on):
+        from lodestar_tpu.ops import sha256 as ops
+
+        rng = np.random.default_rng(8)
+        chunks = rng.integers(0, 256, size=(16, 32), dtype=np.uint8)
+        node = T.subtree_from_chunks(chunks, 4)
+        got = dh.compute_root_node(node)
+        words = ops.words_from_bytes(chunks.tobytes())
+        expect = ops.bytes_from_words(np.asarray(ops.merkle_root_device(words))[None])
+        assert got == expect
+
+    def test_one_launch_per_level(self, device_on):
+        rng = np.random.default_rng(9)
+        chunks = rng.integers(0, 256, size=(32, 32), dtype=np.uint8)
+        node = T.subtree_from_chunks(chunks, 5)
+        T.compute_root(node)  # root everything on CPU first
+        # dirty a few scattered leaves: the flush must hash ALL their
+        # paths in exactly depth launches, not per-leaf
+        for i in (0, 7, 19, 30):
+            node = T.set_node(node, (1 << 5) + i, T.leaf(bytes([i]) * 32))
+        before = dh.launch_count()
+        root = dh.compute_root_node(node)
+        launches = dh.launch_count() - before
+        assert launches == 5  # exactly one hash_pairs dispatch per level
+        mutated = chunks.copy()
+        for i in (0, 7, 19, 30):
+            mutated[i] = np.frombuffer(bytes([i]) * 32, dtype=np.uint8)
+        assert root == merkleize(mutated, limit=32)
+
+
+class TestCollectorStackPath:
+    def _stack(self, chunks):
+        pow2 = 1 << (max(chunks.shape[0], 1) - 1).bit_length() if chunks.shape[0] > 1 else 1
+        levels = [np.zeros((pow2 >> k, 32), dtype=np.uint8) for k in range(pow2.bit_length())]
+        levels[0][: chunks.shape[0]] = chunks
+        return levels
+
+    def test_stack_flush_matches_merkleize(self, device_on):
+        rng = np.random.default_rng(10)
+        chunks = rng.integers(0, 256, size=(16, 32), dtype=np.uint8)
+        levels = self._stack(chunks)
+        coll = dh.DirtyCollector()
+        coll.add_stack_job(levels, range(16))
+        stats = coll.flush()
+        assert stats["backend"] == "device"
+        assert stats["launches"] == 4
+        assert levels[-1][0].tobytes() == merkleize(chunks, limit=16)
+
+    def test_two_jobs_share_launches(self, device_on):
+        rng = np.random.default_rng(11)
+        a = rng.integers(0, 256, size=(8, 32), dtype=np.uint8)
+        b = rng.integers(0, 256, size=(16, 32), dtype=np.uint8)
+        la, lb = self._stack(a), self._stack(b)
+        coll = dh.DirtyCollector()
+        coll.add_stack_job(la, range(8))
+        coll.add_stack_job(lb, range(16))
+        stats = coll.flush()
+        # max depth governs: 4 levels for the 16-chunk job, the 8-chunk
+        # job's 3 levels ride the same dispatches
+        assert stats["launches"] == 4
+        assert la[-1][0].tobytes() == merkleize(a, limit=8)
+        assert lb[-1][0].tobytes() == merkleize(b, limit=16)
+
+    def test_incremental_dirty_subset(self, device_on):
+        rng = np.random.default_rng(12)
+        chunks = rng.integers(0, 256, size=(32, 32), dtype=np.uint8)
+        levels = self._stack(chunks)
+        coll = dh.DirtyCollector()
+        coll.add_stack_job(levels, range(32))
+        coll.flush()
+        # mutate two chunks, flush only those paths
+        chunks2 = chunks.copy()
+        chunks2[3] = 1
+        chunks2[29] = 2
+        levels[0][:32] = chunks2
+        coll2 = dh.DirtyCollector()
+        coll2.add_stack_job(levels, [3, 29])
+        stats = coll2.flush()
+        assert stats["launches"] == 5
+        assert stats["dirty_chunks"] == 2
+        assert levels[-1][0].tobytes() == merkleize(chunks2, limit=32)
+
+
+class TestDegradation:
+    def test_device_error_degrades_to_cpu_with_identical_root(
+        self, device_on, htr_metrics, monkeypatch
+    ):
+        rng = np.random.default_rng(13)
+        chunks = rng.integers(0, 256, size=(16, 32), dtype=np.uint8)
+        levels = [
+            np.zeros((16 >> k, 32), dtype=np.uint8) for k in range(5)
+        ]
+        levels[0][:] = chunks
+
+        calls = {"n": 0}
+
+        def boom(data):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("injected device fault")
+            return hash_nodes_cpu(data)
+
+        monkeypatch.setattr(dh, "_device_level", boom)
+        coll = dh.DirtyCollector()
+        coll.add_stack_job(levels, range(16))
+        stats = coll.flush()
+        # whole flush degraded: backend reports cpu, fallback counted,
+        # root identical to the pure-CPU computation
+        assert stats["backend"] == "cpu"
+        # launches means DEVICE dispatches: a degraded flush must read
+        # as zero, not as a healthy tree-depth count
+        assert stats["launches"] == 0
+        assert htr_metrics.fallbacks.n == 1
+        assert htr_metrics.flushes.by_label[("cpu",)].n == 1
+        assert levels[-1][0].tobytes() == merkleize(chunks, limit=16)
+
+    def test_hash_level_falls_back(self, device_on, htr_metrics, monkeypatch):
+        def boom(data):
+            raise RuntimeError("injected")
+
+        monkeypatch.setattr(dh, "_device_level", boom)
+        rng = np.random.default_rng(14)
+        data = rng.integers(0, 256, size=(8, 32), dtype=np.uint8)
+        assert np.array_equal(dh.hash_level(data), hash_nodes_cpu(data))
+        assert htr_metrics.fallbacks.n == 1
+
+    def test_hash_level_fallback_never_redispatches(
+        self, device_on, htr_metrics, monkeypatch
+    ):
+        """The error path must use the STRICT host hasher: routing
+        through hash_nodes would re-dispatch big levels to the same
+        broken device and let the error escape the degradation chain."""
+        import lodestar_tpu.ssz.hash as ssz_hash
+
+        def boom(data):
+            raise RuntimeError("device fault")
+
+        monkeypatch.setattr(dh, "_device_level", boom)
+        monkeypatch.setattr(
+            ssz_hash, "hash_nodes", lambda data: (_ for _ in ()).throw(
+                AssertionError("fallback re-entered the auto path")
+            )
+        )
+        rng = np.random.default_rng(16)
+        data = rng.integers(0, 256, size=(64, 32), dtype=np.uint8)
+        assert np.array_equal(dh.hash_level(data), hash_nodes_cpu(data))
+        assert htr_metrics.fallbacks.n == 1
+
+    def test_small_levels_stay_on_host_at_production_floor(self, monkeypatch):
+        """The size asymmetry survives the backend switch: with the
+        production pair floor, a tiny level must not pay a device
+        dispatch even in mode on — in hash_level AND in the collector's
+        flush pass (which then reports zero launches)."""
+        prev = dh.configure_device_htr(mode="on")
+        try:
+            monkeypatch.setattr(dh, "DEVICE_MIN_FLUSH_PAIRS", 2048)
+
+            def boom(data):
+                raise AssertionError("small level dispatched to device")
+
+            monkeypatch.setattr(dh, "_device_level", boom)
+            rng = np.random.default_rng(15)
+            data = rng.integers(0, 256, size=(8, 32), dtype=np.uint8)
+            assert np.array_equal(dh.hash_level(data), hash_nodes_cpu(data))
+            chunks = rng.integers(0, 256, size=(16, 32), dtype=np.uint8)
+            levels = [np.zeros((16 >> k, 32), dtype=np.uint8) for k in range(5)]
+            levels[0][:] = chunks
+            coll = dh.DirtyCollector()
+            coll.add_stack_job(levels, range(16))
+            stats = coll.flush()
+            assert stats["launches"] == 0  # all levels under the floor
+            assert levels[-1][0].tobytes() == merkleize(chunks, limit=16)
+        finally:
+            dh.configure_device_htr(mode=prev)
+
+
+class TestViews:
+    LT = List(uint64, 2**40)
+
+    def test_view_roots_match_cpu_path(self, device_on):
+        vals = [3 * i for i in range(300)]
+        view = T.tree_view(self.LT, vals)
+        view.set(17, 9999)
+        view.push(41)
+        expect = list(vals)
+        expect[17] = 9999
+        expect.append(41)
+        assert view.hash_tree_root() == self.LT.hash_tree_root(expect)
+
+    def test_dirty_gindices_recorded_and_cleared(self, device_on, htr_metrics):
+        vals = [i for i in range(20)]
+        view = T.tree_view(self.LT, vals)
+        view.hash_tree_root()  # settle the initial build
+        base = htr_metrics.dirty_chunks.n
+        view.set(0, 5)
+        view.set(8, 6)
+        assert view.dirty_count() == 2
+        assert len(view.dirty_gindices()) == 2
+        view.hash_tree_root()
+        assert view.dirty_count() == 0
+        # the recorded gindex count is what the metric attributes
+        assert htr_metrics.dirty_chunks.n - base == 2
+
+    def test_container_view_dirty_fields(self, device_on):
+        C = Container("Mini", [("a", uint64), ("r", Bytes32)])
+        v = ContainerValue(C, a=1, r=b"\x01" * 32)
+        view = T.tree_view(C, v)
+        view.set("a", 7)
+        assert view.dirty_count() == 1
+        assert view.hash_tree_root() == C.hash_tree_root(
+            ContainerValue(C, a=7, r=b"\x01" * 32)
+        )
+        assert view.dirty_count() == 0
+
+
+class TestBatchHook:
+    C = Container(
+        "Rec",
+        [("k", Bytes48), ("w", Bytes32), ("b", uint64), ("s", boolean)],
+    )
+
+    def _vals(self, n):
+        return [
+            ContainerValue(
+                self.C, k=bytes([i % 250]) * 48, w=bytes([i % 7]) * 32, b=i, s=bool(i % 2)
+            )
+            for i in range(n)
+        ]
+
+    def test_batch_roots_identical_device_and_cpu(self, device_on):
+        vals = self._vals(33)
+        dev = batch_container_roots(self.C, vals)
+        prev = dh.configure_device_htr(mode="off")
+        try:
+            cpu = batch_container_roots(self.C, vals)
+        finally:
+            dh.configure_device_htr(mode=prev)
+        assert np.array_equal(dev, cpu)
+        for i, v in enumerate(vals):
+            assert dev[i].tobytes() == self.C.hash_tree_root(v)
+
+
+class TestRandomizedDifferential:
+    def test_mutation_sequence_fuzz(self, device_on):
+        """Random set/push storms on a basic-list view: device-flushed
+        root == CPU incremental root == from-scratch merkleize at every
+        commit."""
+        rng = np.random.default_rng(42)
+        vals = [int(x) for x in rng.integers(0, 2**63, size=50)]
+        view_dev = T.tree_view(self.__class__.LT, vals)
+        view_cpu = T.tree_view(self.__class__.LT, vals)
+        model = list(vals)
+        for round_ in range(6):
+            for _ in range(int(rng.integers(1, 8))):
+                if model and rng.random() < 0.7:
+                    i = int(rng.integers(0, len(model)))
+                    v = int(rng.integers(0, 2**63))
+                    view_dev.set(i, v)
+                    view_cpu.set(i, v)
+                    model[i] = v
+                else:
+                    v = int(rng.integers(0, 2**63))
+                    view_dev.push(v)
+                    view_cpu.push(v)
+                    model.append(v)
+            r_dev = view_dev.hash_tree_root()
+            prev = dh.configure_device_htr(mode="off")
+            try:
+                r_cpu = view_cpu.hash_tree_root()
+            finally:
+                dh.configure_device_htr(mode=prev)
+            assert r_dev == r_cpu == self.__class__.LT.hash_tree_root(model), round_
+
+    LT = List(uint64, 2**32)

@@ -1,5 +1,6 @@
 """lock-discipline: annotated shared attributes are only touched under
-their declared lock.
+their declared lock (or, for loop-confined guards, only written by
+their declared owner context).
 
 Declaration convention — a trailing comment on the attribute's
 assignment (normally in ``__init__``)::
@@ -7,6 +8,7 @@ assignment (normally in ``__init__``)::
     self._outstanding = 0          # guarded by: _lock
     self.healthy = True            # guarded by: _lock [shared] — owning client's
     self._buffered = []            # guarded by: event-loop (single-threaded)
+    self.trust = 1.0               # guarded by: audit-thread (single writer)
 
 * ``# guarded by: <lock>`` — `<lock>` is a Python identifier naming the
   guarding lock attribute (``_lock``, ``_fs_lock``, ...). Every
@@ -17,10 +19,36 @@ assignment (normally in ``__init__``)::
   too (e.g. ``_Endpoint`` state owned by the client's lock): the check
   widens to every ``<name>.<attr>`` access in the module. Use only for
   attribute names that are unambiguous within their module.
-* A non-identifier guard (``event-loop``, ``advisory``, ``contextvar``,
-  ...) is DOCUMENTATION ONLY: it records why the attribute needs no
-  lock; nothing is enforced. This keeps the annotation honest for
-  loop-confined or racy-benign-by-design state.
+* ``event-loop`` / ``audit-thread`` / ``probe-thread`` — loop-confined
+  OWNERSHIP guards, enforced as single-writer checks: every WRITE to
+  the attribute (assignment, augmented assignment, delete, or an
+  in-place mutator call like ``.append``/``.clear``/``.add``) must sit
+  in a function owned by the declared context. Reads are deliberately
+  unrestricted — these annotations exist precisely because stale reads
+  from other threads are benign by design; the invariant worth
+  machine-checking is that only the owner mutates. Ownership is
+  computed per module as a fixpoint over the intra-module reference
+  graph:
+
+  - ``event-loop`` owner roots: ``async def`` functions, plus functions
+    and lambdas REGISTERED with the loop (passed to ``call_later`` /
+    ``call_soon`` / ``call_at`` / ``call_soon_threadsafe`` /
+    ``add_done_callback`` / ``create_task`` / ``ensure_future`` /
+    ``run_coroutine_threadsafe``).
+  - ``*-thread`` owner roots: functions passed as ``target=`` to a
+    ``Thread(...)`` construction in the module.
+  - A sync helper is owned when every in-module reference to it comes
+    from an owned scope (registration sites don't count as references —
+    they are how a root is declared, not an invocation). Like the
+    lexical lock tracking, ownership is by NAME within the module and
+    loop-confined guards widen to non-``self`` receivers (a probe
+    thread mutating ``ep.consecutive_failures`` is the canonical case);
+    both are the repo's naming-discipline approximation, not alias
+    analysis.
+
+* Any other non-identifier guard (``advisory-only``, ``config-time``,
+  ``contextvar``, ...) is DOCUMENTATION ONLY: it records why the
+  attribute needs no lock; nothing is enforced.
 
 Lock identity is lexical (see `_locks`): helper methods that run with
 the caller's lock held carry a def-line
@@ -34,10 +62,47 @@ import re
 from dataclasses import dataclass
 
 from ..core import Finding, Rule, SourceFile
-from ._locks import WithLockTracker
+from ._locks import WithLockTracker, _last_segment
 
 _GUARD_RE = re.compile(r"#\s*guarded by:\s*(\S+)(.*)$")
 _IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+#: loop-confined guards enforced as single-writer ownership, mapped to
+#: their owner-root kind
+_OWNER_GUARDS = {
+    "event-loop": "loop",
+    "audit-thread": "thread",
+    "probe-thread": "thread",
+}
+
+#: loop APIs whose function-valued arguments run ON the event loop
+_LOOP_SCHEDULERS = {
+    "call_later",
+    "call_soon",
+    "call_at",
+    "call_soon_threadsafe",
+    "add_done_callback",
+    "create_task",
+    "ensure_future",
+    "run_coroutine_threadsafe",
+}
+
+#: method calls that mutate the receiver in place (the write shapes a
+#: single-writer guard must own)
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popleft",
+    "remove",
+    "setdefault",
+    "update",
+}
 
 
 @dataclass
@@ -48,6 +113,7 @@ class GuardDecl:
     enforced: bool
     cls: str
     line: int
+    owner: str | None = None  # "loop" / "thread" for owner-enforced guards
 
 
 def collect_decls(sf: SourceFile) -> dict[str, list[GuardDecl]]:
@@ -83,7 +149,15 @@ def collect_decls(sf: SourceFile) -> dict[str, list[GuardDecl]]:
                 if target.value.id == "self":
                     lock, shared, enforced = g
                     decls.setdefault(target.attr, []).append(
-                        GuardDecl(target.attr, lock, shared, enforced, self.cls[-1], line)
+                        GuardDecl(
+                            target.attr,
+                            lock,
+                            shared,
+                            enforced,
+                            self.cls[-1],
+                            line,
+                            owner=_OWNER_GUARDS.get(lock),
+                        )
                     )
 
         def visit_Assign(self, node: ast.Assign) -> None:
@@ -100,18 +174,153 @@ def collect_decls(sf: SourceFile) -> dict[str, list[GuardDecl]]:
     return decls
 
 
+class _OwnerAnalysis:
+    """Module-level ownership fixpoint for the loop-confined guards.
+
+    `scope_owned(node, kind)` answers whether the function/lambda scope
+    node is owned by the event loop ("loop") or a module thread
+    ("thread")."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.func_defs: dict[str, list[ast.AST]] = {}
+        self.async_names: set[str] = set()
+        self.thread_targets: set[str] = set()
+        self.loop_registered: set[str] = set()
+        self.owned_lambdas: set[int] = set()  # id() of scheduler-arg lambdas
+        self.refs: dict[str, list[ast.AST | None]] = {}
+        self._registration_nodes: set[int] = set()
+        self._collect(tree)
+        self.owned_loop = self._fixpoint("loop")
+        self.owned_thread = self._fixpoint("thread")
+
+    # -- collection ------------------------------------------------------------
+
+    def _collect(self, tree: ast.AST) -> None:
+        defs = self.func_defs
+        outer = self
+
+        class _Pre(ast.NodeVisitor):
+            """Pass 1: function defs + registration sites (Thread
+            targets, loop-scheduled callables)."""
+
+            def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+                defs.setdefault(node.name, []).append(node)
+                self.generic_visit(node)
+
+            def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+                defs.setdefault(node.name, []).append(node)
+                outer.async_names.add(node.name)
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                fname = _last_segment(node.func)
+                if fname == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            seg = _last_segment(kw.value)
+                            if seg is not None:
+                                outer.thread_targets.add(seg)
+                                outer._registration_nodes.add(id(kw.value))
+                elif fname in _LOOP_SCHEDULERS:
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        if isinstance(arg, ast.Lambda):
+                            outer.owned_lambdas.add(id(arg))
+                        else:
+                            seg = _last_segment(arg)
+                            if seg is not None:
+                                outer.loop_registered.add(seg)
+                                outer._registration_nodes.add(id(arg))
+                self.generic_visit(node)
+
+        _Pre().visit(tree)
+
+        class _Refs(ast.NodeVisitor):
+            """Pass 2: every non-registration reference to a known
+            function name, attributed to its innermost scope."""
+
+            def __init__(self) -> None:
+                self.scope: list[ast.AST] = []
+
+            def _func(self, node) -> None:
+                self.scope.append(node)
+                self.generic_visit(node)
+                self.scope.pop()
+
+            visit_FunctionDef = _func
+            visit_AsyncFunctionDef = _func
+            visit_Lambda = _func
+
+            def _ref(self, node: ast.expr, name: str) -> None:
+                if name in defs and id(node) not in outer._registration_nodes:
+                    outer.refs.setdefault(name, []).append(
+                        self.scope[-1] if self.scope else None
+                    )
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                self._ref(node, node.attr)
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name) -> None:
+                self._ref(node, node.id)
+
+        _Refs().visit(tree)
+
+    # -- fixpoint --------------------------------------------------------------
+
+    def _roots(self, kind: str) -> set[str]:
+        if kind == "loop":
+            return self.async_names | self.loop_registered
+        return set(self.thread_targets)
+
+    def _fixpoint(self, kind: str) -> set[str]:
+        owned = {n for n in self._roots(kind) if n in self.func_defs}
+        changed = True
+        while changed:
+            changed = False
+            for name in self.func_defs:
+                if name in owned:
+                    continue
+                rs = self.refs.get(name)
+                if not rs:
+                    continue
+                if all(self._scope_owned_in(s, owned, kind) for s in rs):
+                    owned.add(name)
+                    changed = True
+        return owned
+
+    def _scope_owned_in(self, scope, owned: set[str], kind: str) -> bool:
+        if scope is None:
+            return False
+        if isinstance(scope, ast.Lambda):
+            return kind == "loop" and id(scope) in self.owned_lambdas
+        return scope.name in owned
+
+    # -- query -----------------------------------------------------------------
+
+    def scope_owned(self, scope, kind: str) -> bool:
+        owned = self.owned_loop if kind == "loop" else self.owned_thread
+        return self._scope_owned_in(scope, owned, kind)
+
+
 class LockDisciplineRule(Rule):
     name = "lock-discipline"
     description = (
-        "attributes annotated '# guarded by: <lock>' must only be "
-        "read/written inside a 'with ...<lock>:' block"
+        "attributes annotated '# guarded by: <lock>' are only touched "
+        "under 'with ...<lock>:'; loop-confined guards (event-loop, "
+        "audit-thread, probe-thread) are only WRITTEN by their owner"
     )
 
     def check(self, sf: SourceFile):
         decls = collect_decls(sf)
-        if not any(d.enforced for ds in decls.values() for d in ds):
+        if not any(d.enforced or d.owner for ds in decls.values() for d in ds):
             return []
         findings: list[Finding] = []
+
+        owner_analysis = (
+            _OwnerAnalysis(sf.tree)
+            if any(d.owner for ds in decls.values() for d in ds)
+            else None
+        )
 
         # [shared] widens enforcement module-wide by NAME; if another
         # class declares the same attribute under a different guard,
@@ -129,8 +338,77 @@ class LockDisciplineRule(Rule):
                         )
                     )
 
+        rule_name = self.name
+
         class _V(WithLockTracker):
+            def __init__(self) -> None:
+                super().__init__()
+                self.scope_nodes: list[ast.AST] = []
+
+            def _visit_func(self, node) -> None:
+                self.scope_nodes.append(node)
+                super()._visit_func(node)
+                self.scope_nodes.pop()
+
+            def visit_Lambda(self, node: ast.Lambda) -> None:
+                self.scope_nodes.append(node)
+                super().visit_Lambda(node)
+                self.scope_nodes.pop()
+
+            # -- owner (single-writer) enforcement ----------------------------
+
+            def _owner_write(self, node: ast.Attribute) -> None:
+                """`node` is a guarded attribute being WRITTEN (store,
+                del, augassign target, or in-place mutator receiver)."""
+                ds = decls.get(node.attr)
+                if not ds or self.in_init():
+                    return
+                # owner guards follow the attribute through any receiver
+                # (single-writer state routinely lives on helper objects)
+                for d in ds:
+                    if d.owner is None:
+                        continue
+                    scope = self.scope_nodes[-1] if self.scope_nodes else None
+                    if not owner_analysis.scope_owned(scope, d.owner):
+                        findings.append(
+                            Finding(
+                                rule_name,
+                                sf.path,
+                                node.lineno,
+                                f"'{node.attr}' is owned by '{d.lock}' "
+                                f"(declared {d.cls}:{d.line}) but written "
+                                f"outside a {d.lock}-owned scope",
+                            )
+                        )
+                        break
+
+            def visit_Call(self, node: ast.Call) -> None:
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATORS
+                    and isinstance(f.value, ast.Attribute)
+                    and f.value.attr in decls
+                ):
+                    self._owner_write(f.value)
+                self.generic_visit(node)
+
+            def visit_Subscript(self, node: ast.Subscript) -> None:
+                # item writes are writes: `self._buffered[0] = x` /
+                # `del self._buffered[0]` put Store/Del on the
+                # SUBSCRIPT while the guarded Attribute reads as Load —
+                # the most common mutation shape must not slip through
+                if (
+                    isinstance(node.ctx, (ast.Store, ast.Del))
+                    and isinstance(node.value, ast.Attribute)
+                    and node.value.attr in decls
+                ):
+                    self._owner_write(node.value)
+                self.generic_visit(node)
+
             def visit_Attribute(self, node: ast.Attribute) -> None:
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    self._owner_write(node)
                 ds = decls.get(node.attr)
                 if ds and not self.in_init():
                     is_self = (

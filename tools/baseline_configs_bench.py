@@ -264,10 +264,156 @@ def device_prep_rate():
           rate / REFERENCE_SIGS_PER_SEC_PER_CORE)
 
 
+def state_htr_rate():
+    """Dirty-subtree collector throughput on the config-4 state shape:
+    a 2^18-chunk retained level stack (2^16 with --quick) takes a
+    4096-chunk dirty set per flush — the epoch-boundary balances sweep
+    shape — through one device launch per level. The honest unit is
+    dirty chunks *flushed* per second (path re-hash included)."""
+    import numpy as np
+
+    from lodestar_tpu.ssz import device_htr as dh
+
+    depth = 16 if QUICK else 18
+    n = 1 << depth
+    rng = np.random.default_rng(51)
+    chunks = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+    levels = [np.zeros((n >> k, 32), dtype=np.uint8) for k in range(depth + 1)]
+    levels[0][:] = chunks
+    prev = dh.configure_device_htr(mode="on")
+    try:
+        cold = dh.DirtyCollector()
+        cold.add_stack_job(levels, range(n))
+        cold.flush()  # warm the per-size-class compiles
+        dirty_n = 4096
+        iters = 5
+        t0 = time.perf_counter()
+        for it in range(iters):
+            dirty = rng.choice(n, size=dirty_n, replace=False)
+            levels[0][dirty] ^= np.uint8(1 + it)
+            coll = dh.DirtyCollector()
+            coll.add_stack_job(levels, dirty)
+            stats = coll.flush()
+            if stats["backend"] != "device":
+                raise RuntimeError(
+                    "device flush silently degraded to CPU — refusing to "
+                    "report a CPU number under a device metric name"
+                )
+            if stats["launches"] > depth:
+                raise RuntimeError("launch-count invariant violated in bench")
+        dt = (time.perf_counter() - t0) / iters
+    finally:
+        dh.configure_device_htr(mode=prev)
+    rate = dirty_n / dt
+    # reference envelope: one host core does ~1M incremental pair
+    # hashes/s through hashlib (BASELINE.md config 4 discussion)
+    _line("state_htr_chunks_per_sec", rate, "chunks/s", rate / 1_000_000.0)
+
+
+def epoch_htr_replay():
+    """Epoch-boundary hashTreeRoot replay: a minimal-preset state with a
+    big registry takes an epoch-shaped mutation batch (every balance
+    rewritten, participation swept, a mix/slashings rotation, a handful
+    of validator writes), then one state root — device collector vs the
+    CPU value path, same JSON-lines shape as the prep-on/off pair."""
+    import numpy as np
+
+    from lodestar_tpu import params
+    from lodestar_tpu.ssz import device_htr as dh
+    from lodestar_tpu.state_transition import state_hash_tree_root
+    from lodestar_tpu.types import ssz_types
+
+    prev_preset = params.active_preset()
+    params.set_active_preset("minimal")
+    p = params.active_preset()
+    t = ssz_types(p)
+    n = 1024 if QUICK else 16384
+    state = t.altair.BeaconState.default()
+    vs = []
+    for i in range(n):
+        v = t.Validator.default()
+        v.pubkey = (i.to_bytes(8, "little") * 6)[:48]
+        v.effective_balance = 32_000_000_000
+        v.exit_epoch = 2**64 - 1
+        v.withdrawable_epoch = 2**64 - 1
+        vs.append(v)
+    state.validators = vs
+    state.balances = [32_000_000_000] * n
+    state.previous_epoch_participation = [1] * n
+    state.current_epoch_participation = [3] * n
+    state.inactivity_scores = [0] * n
+    rng = np.random.default_rng(52)
+
+    def epoch_mutation(round_):
+        state.slot = int(state.slot) + p.SLOTS_PER_EPOCH
+        state.balances = [int(x) for x in rng.integers(31_000_000_000, 33_000_000_000, size=n)]
+        state.previous_epoch_participation = state.current_epoch_participation
+        state.current_epoch_participation = [0] * n
+        state.randao_mixes[round_ % len(state.randao_mixes)] = bytes(
+            rng.integers(0, 256, size=32, dtype=np.uint8)
+        )
+        state.slashings[round_ % len(state.slashings)] = int(rng.integers(0, 2**40))
+        for i in rng.integers(0, n, size=8):
+            state.validators[int(i)].effective_balance = int(rng.integers(0, 2**40))
+
+    # degradation probe: zero launches can be legitimate (the per-level
+    # size floor keeps small levels on host digests), but a FALLBACK
+    # means the device path errored and the line would silently report
+    # a CPU number under a device metric name
+    class _Probe:
+        def __init__(self):
+            self.n = 0
+
+        def labels(self, *a):
+            return self
+
+        def inc(self, amount=1):
+            self.n += amount
+
+        def observe(self, v):
+            pass
+
+    probe = type("M", (), {})()
+    for k in ("flushes", "dirty_chunks", "launches", "seconds", "fallbacks"):
+        setattr(probe, k, _Probe())
+
+    results = {}
+    prev_metrics = dh._htr_metrics
+    dh.configure_device_htr(metrics=probe)
+    try:
+        for mode, metric in (("on", "epoch_htr_ms_device"), ("off", "epoch_htr_ms_cpu")):
+            prev = dh.configure_device_htr(mode=mode)
+            try:
+                epoch_mutation(0)
+                state_hash_tree_root(state)  # warm (cold tracker build / compiles)
+                iters = 3
+                t0 = time.perf_counter()
+                for it in range(1, iters + 1):
+                    epoch_mutation(it)
+                    state_hash_tree_root(state)
+                results[metric] = (time.perf_counter() - t0) / iters * 1000.0
+                if mode == "on" and probe.fallbacks.n:
+                    raise RuntimeError(
+                        "device HTR degraded during the epoch replay — "
+                        "refusing to report epoch_htr_ms_device"
+                    )
+            finally:
+                dh.configure_device_htr(mode=prev)
+    finally:
+        dh._htr_metrics = prev_metrics
+        params.set_active_preset(prev_preset)
+    cpu_ms = results["epoch_htr_ms_cpu"]
+    _line("epoch_htr_ms_device", results["epoch_htr_ms_device"], "ms",
+          cpu_ms / max(results["epoch_htr_ms_device"], 1e-9))
+    _line("epoch_htr_ms_cpu", cpu_ms, "ms", 1.0)
+
+
 def main():
     host_prep_rate()
     device_prep_rate()
     config4_merkle_1m()
+    state_htr_rate()
+    epoch_htr_replay()
     config5_backfill_window()
     config2_gossip_replay()
     config2_gossip_replay(device_prep=True)

@@ -306,6 +306,7 @@ def all_dashboards():
         ("lodestar_sched_occupancy.json", sched_dashboard()),
         ("lodestar_offload_resilience.json", resilience_dashboard()),
         ("lodestar_offload_audit.json", audit_dashboard()),
+        ("lodestar_ssz_htr.json", ssz_htr_dashboard()),
         ("lodestar_node_internals.json", node_internals_dashboard()),
     )
 
@@ -718,6 +719,86 @@ def audit_dashboard():
         "Lodestar TPU - Offload Byzantine audit",
         ps,
         ["lodestar", "audit"],
+    )
+
+
+def ssz_htr_dashboard():
+    """Device hashTreeRoot (ssz/device_htr.py collector +
+    state_transition/htr.py tracker): flush rate per backend, dirty
+    chunk volume, device dispatch rate (all hash_pairs launches —
+    collector flush levels plus shared-hook batch levels; the strict
+    one-per-level-per-flush invariant is asserted by tests, which read
+    the per-collector counter), flush latency, and degradations by
+    leg. (prometheus_client suffixes counters with _total — every
+    counter expr below carries it.)"""
+    ps = [
+        panel(
+            "Collector flushes by backend",
+            [
+                (
+                    "sum by (backend) (rate(lodestar_ssz_htr_flushes_total[5m]))",
+                    "{{backend}}",
+                ),
+            ],
+            unit="ops", pid=1,
+        ),
+        panel(
+            "Dirty chunks re-hashed",
+            [("rate(lodestar_ssz_htr_dirty_chunks_total[5m])", "chunks/s")],
+            unit="ops", x=12, pid=2,
+        ),
+        panel(
+            "Device dispatch rate (flush levels + batch-hook levels)",
+            [
+                (
+                    "sum (rate(lodestar_ssz_htr_launches_total[5m]))",
+                    "dispatches/s",
+                ),
+                (
+                    'sum (rate(lodestar_ssz_htr_flushes_total{backend="device"}[5m]))',
+                    "device flushes/s",
+                ),
+            ],
+            unit="ops", y=8, pid=3,
+        ),
+        panel(
+            "Flush wall time p95 by backend",
+            [
+                (
+                    "histogram_quantile(0.95, sum by (le, backend) "
+                    "(rate(lodestar_ssz_htr_seconds_bucket[5m])))",
+                    "p95 {{backend}}",
+                ),
+            ],
+            unit="s", x=12, y=8, pid=4,
+        ),
+        panel(
+            "Degradations by leg (flush = device fault, tracker = logic bug)",
+            [
+                (
+                    "sum by (leg) (rate(lodestar_ssz_htr_fallback_total[5m]))",
+                    "{{leg}}",
+                ),
+            ],
+            unit="ops", y=16, pid=5,
+        ),
+        panel(
+            "State hashTreeRoot time (state-transition histogram)",
+            [
+                (
+                    "histogram_quantile(0.95, sum by (le) "
+                    "(rate(lodestar_stfn_hash_tree_root_seconds_bucket[5m])))",
+                    "p95",
+                ),
+            ],
+            unit="s", x=12, y=16, pid=6,
+        ),
+    ]
+    return dashboard(
+        "lodestar-ssz-htr",
+        "Lodestar TPU - Device hashTreeRoot",
+        ps,
+        ["lodestar", "ssz"],
     )
 
 
