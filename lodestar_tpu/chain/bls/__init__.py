@@ -7,6 +7,13 @@ from .interface import (  # noqa: F401
     IBlsVerifier,
     VerifySignatureOpts,
 )
+from .mesh import (  # noqa: F401
+    MESH_MODES,
+    MeshLane,
+    VerifierMesh,
+    build_device_mesh,
+    single_lane_mesh,
+)
 from .pool import (  # noqa: F401
     BATCHABLE_MIN_PER_CHUNK,
     MAX_BUFFER_WAIT_MS,

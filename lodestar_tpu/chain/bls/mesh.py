@@ -1,0 +1,296 @@
+"""Verifier mesh: per-device launch lanes behind one verifier pool.
+
+The device core passed the 8-device dryrun (`verify_signature_sets_sharded`,
+MULTICHIP_r0*.json) but until PR 8 the production pool drove one chip.
+This module is the mesh's serving shape:
+
+* `MeshLane` — one chip: its own verify callable, its own EWMA
+  `OccupancyTracker`, and its own wedge `CircuitBreaker` so a sick
+  device (driver hang, OOM loop) degrades the pool to an (N-1)-chip
+  mesh instead of tripping the whole pool.
+* `VerifierMesh` — the lane set plus an optional data-parallel sharded
+  verify callable (bulk range-sync/backfill batches run one launch
+  across several idle chips). The mesh also answers the fleet-level
+  questions the offload Status frame ships to clients: aggregate
+  occupancy over *available* chips and the per-chip table (a wedged
+  chip drops out of the advertised capacity).
+* `build_device_mesh` — production construction from the models layer's
+  device enumeration. `"auto"` engages only when the Pallas backend is
+  live AND more than one device is visible (the same doctrine as
+  `--bls-device-prep auto`): on the CPU-forced 8-device test platform
+  auto stays single-lane, so a default pool behaves exactly like the
+  pre-mesh code unless a test asks for the mesh explicitly.
+
+Placement policy lives in the pool (`chain/bls/pool.py`): latency-class
+work dequeues to the least-occupied free lane; bulk work shards across
+idle lanes when at least two are free and the batch is large enough to
+amortize the collective launch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from lodestar_tpu.scheduler import OccupancyTracker
+
+__all__ = [
+    "MeshLane",
+    "VerifierMesh",
+    "build_device_mesh",
+    "single_lane_mesh",
+    "mesh_launch",
+    "MESH_MODES",
+    "LANE_WEDGE_THRESHOLD",
+    "SHARD_MIN_SETS_PER_LANE",
+    "SHARD_DISABLE_THRESHOLD",
+]
+
+#: pool-facing mesh modes. cli.py keeps a literal copy: importing this
+#: module at argparse time would pull the chain.bls package __init__
+#: and with it the crypto self-check asserts (~2s on --help); the
+#: wiring doctrine is that node/BeaconNodeOptions validates against
+#: THIS tuple post-parse, so a drifted CLI copy fails loudly there
+MESH_MODES = ("auto", "on", "off")
+
+# consecutive launch errors before ONE lane reports itself wedged —
+# same rationale as the pre-mesh pool-wide DEVICE_WEDGE_THRESHOLD:
+# high enough that one bad batch + its retries can't trip it, low
+# enough to stop a launch storm against a hung driver
+LANE_WEDGE_THRESHOLD = 8
+LANE_WEDGE_RESET_S = 5.0
+LANE_WEDGE_MAX_RESET_S = 60.0
+
+#: a bulk batch shards over at most len(sets)//this lanes — a 32-set
+#: batch across 8 chips would pay 8 collective dispatches to save one
+#: small launch
+SHARD_MIN_SETS_PER_LANE = 16
+
+#: consecutive sharded-launch errors before the mesh stops trying the
+#: collective program (single-lane launches attribute errors to the
+#: exact sick chip; the sharded launch cannot, so it gets its own gate)
+SHARD_DISABLE_THRESHOLD = 3
+
+
+class MeshLane:
+    """One device lane: verify callable + occupancy + wedge breaker.
+
+    `inflight` is dispatcher state (how many packages the pool has in
+    flight on this lane) and is only touched on the event loop; the
+    occupancy tracker and breaker are thread-safe because the launches
+    themselves run on executor threads."""
+
+    def __init__(
+        self,
+        index: int,
+        verify_fn: Callable,
+        *,
+        label: str | None = None,
+        wedge_threshold: int = LANE_WEDGE_THRESHOLD,
+        wedge_reset_s: float = LANE_WEDGE_RESET_S,
+    ) -> None:
+        from lodestar_tpu.offload.resilience import CircuitBreaker
+
+        self.index = index
+        self.label = label if label is not None else f"dev{index}"
+        self.verify_fn = verify_fn
+        self.occupancy = OccupancyTracker()
+        self.breaker = CircuitBreaker(
+            failure_threshold=wedge_threshold,
+            reset_timeout_s=wedge_reset_s,
+            max_reset_timeout_s=LANE_WEDGE_MAX_RESET_S,
+        )
+        self.inflight = 0  # guarded by: event-loop (dispatcher-owned)
+        self.wedge_trips = 0  # guarded by: advisory-only (monotonic trip count, read by tests/metrics)
+        self.launches = 0  # guarded by: advisory-only (monotonic launch count)
+
+    @property
+    def wedged(self) -> bool:
+        return self.breaker.is_open
+
+    def state(self) -> dict:
+        return {
+            "device": self.label,
+            "occupancy_permille": self.occupancy.occupancy_permille(),
+            "wedged": self.wedged,
+            "inflight": self.inflight,
+            "wedge_trips": self.wedge_trips,
+            "launches": self.launches,
+        }
+
+
+class VerifierMesh:
+    """Lane set + optional sharded collective. Duck-types the occupancy
+    interface `AdmissionController` expects (`occupancy()`), reporting
+    the MEAN busy fraction over available lanes — the admission
+    thresholds (0.75 / 0.95) grade fleet headroom, not "any chip busy".
+    With one lane this is exactly that lane's tracker value, so the
+    pre-mesh admission behavior is unchanged."""
+
+    def __init__(self, lanes: Sequence[MeshLane], *, sharded_fn: Callable | None = None):
+        if not lanes:
+            raise ValueError("a verifier mesh needs at least one lane")
+        self.lanes = list(lanes)
+        #: sharded_fn(sets, device_indices) -> bool over >=2 lanes
+        self.sharded_fn = sharded_fn
+        from lodestar_tpu.offload.resilience import CircuitBreaker
+
+        # gates the collective program only: a sharded error cannot name
+        # the sick chip, so it must not wedge per-lane breakers — instead
+        # repeated collective failures park the sharded path while
+        # single-lane launches keep attributing errors per chip
+        self.sharded_breaker = CircuitBreaker(
+            failure_threshold=SHARD_DISABLE_THRESHOLD,
+            reset_timeout_s=LANE_WEDGE_RESET_S,
+            max_reset_timeout_s=LANE_WEDGE_MAX_RESET_S,
+        )
+
+    def __len__(self) -> int:
+        return len(self.lanes)
+
+    def available(self) -> list[MeshLane]:
+        """Lanes whose wedge breaker admits work (the (N-1) degradation
+        set). May be empty — the pool then fails fast like the pre-mesh
+        wedged-device path."""
+        return [lane for lane in self.lanes if not lane.wedged]
+
+    def sharding_available(self) -> bool:
+        return self.sharded_fn is not None and not self.sharded_breaker.is_open
+
+    def occupancy(self) -> float:
+        lanes = self.available() or self.lanes
+        return sum(lane.occupancy.occupancy() for lane in lanes) / len(lanes)
+
+    def occupancy_permille(self) -> int:
+        return max(0, min(1000, int(round(self.occupancy() * 1000.0))))
+
+    def chip_table(self) -> list[tuple[int, bool]]:
+        """(occupancy_permille, wedged) per chip — the Status frame's
+        mesh trailer. A wedged chip stays listed (so operators see it)
+        but flagged, and clients drop it from advertised capacity."""
+        return [
+            (lane.occupancy.occupancy_permille(), lane.wedged) for lane in self.lanes
+        ]
+
+    def lane_states(self) -> list[dict]:
+        return [lane.state() for lane in self.lanes]
+
+
+def mesh_launch(
+    mesh: VerifierMesh,
+    sets,
+    *,
+    prefer: MeshLane | None = None,
+    on_launch: Callable | None = None,
+    on_wedge: Callable | None = None,
+) -> tuple[bool, MeshLane]:
+    """One verify launch with per-lane wedge accounting and cross-lane
+    error retry — the single-launch core shared by the pool's executor
+    path and the standalone offload host's backend.
+
+    Starts on `prefer` (default: the least-occupied available lane;
+    every lane when all are wedged, failing fast through the sick chip
+    so its breaker earns the half-open retrial). A backend ERROR
+    records the failing lane's breaker — firing `on_wedge(lane)` on the
+    closed→open transition — and retries on each remaining available
+    sibling, least-occupied first; the verdict is unchanged and the
+    call raises only when every candidate errored. `on_launch(lane)`
+    fires per attempt (metrics). Returns (ok, lane_that_served)."""
+    if prefer is None or (prefer.wedged and mesh.available()):
+        # no preference, or the preferred lane wedged since dispatch
+        # (mid-package: chunk N trips the breaker, chunk N+1 must not
+        # keep feeding the hung driver): start on a healthy lane
+        lanes = mesh.available() or mesh.lanes
+        prefer = min(lanes, key=lambda l: l.occupancy.occupancy())
+    tried: list[MeshLane] = []
+    current = prefer
+    while True:
+        tried.append(current)
+        try:
+            with current.occupancy.launch():
+                ok = bool(current.verify_fn(sets))
+        except Exception:
+            was_open = current.breaker.is_open
+            current.breaker.record_failure()
+            if not was_open and current.breaker.is_open:
+                current.wedge_trips += 1
+                if on_wedge is not None:
+                    on_wedge(current)
+            current.launches += 1
+            if on_launch is not None:
+                on_launch(current)
+            candidates = [l for l in mesh.available() if l not in tried]
+            if not candidates:
+                raise
+            current = min(candidates, key=lambda l: l.occupancy.occupancy())
+            continue
+        current.breaker.record_success()
+        current.launches += 1
+        if on_launch is not None:
+            on_launch(current)
+        return ok, current
+
+
+def single_lane_mesh(
+    verify_fn: Callable, *, wedge_threshold: int = LANE_WEDGE_THRESHOLD
+) -> VerifierMesh:
+    """The pre-mesh shape: one lane, no sharded collective."""
+    return VerifierMesh([MeshLane(0, verify_fn, wedge_threshold=wedge_threshold)])
+
+
+def build_device_mesh(
+    mode: str = "auto",
+    *,
+    fallback_verify_fn: Callable | None = None,
+    wedge_threshold: int = LANE_WEDGE_THRESHOLD,
+) -> VerifierMesh:
+    """Production mesh from the models layer's device enumeration.
+
+    mode "off" (or any enumeration problem, or a single visible device)
+    yields the single-lane shape around `fallback_verify_fn` (default:
+    `verify_signature_sets_device`) — bit-identical to the pre-mesh
+    pool. mode "auto" requires the Pallas backend live (same doctrine
+    as device prep auto); mode "on" forces the mesh whenever more than
+    one device is visible."""
+    if mode not in MESH_MODES:
+        raise ValueError(f"bls_mesh must be one of {MESH_MODES}, got {mode!r}")
+
+    def _single() -> VerifierMesh:
+        fn = fallback_verify_fn
+        if fn is None:
+            try:
+                from lodestar_tpu.models.batch_verify import (
+                    verify_signature_sets_device,
+                )
+
+                fn = verify_signature_sets_device
+            except Exception:
+                # a host without a usable jax stack (the standalone
+                # offload server historically served the pure-CPU
+                # oracle) must degrade, not crash at startup
+                from lodestar_tpu.crypto.bls.api import verify_signature_sets
+
+                fn = verify_signature_sets
+        return single_lane_mesh(fn, wedge_threshold=wedge_threshold)
+
+    if mode == "off":
+        return _single()
+    try:
+        from lodestar_tpu.models import batch_verify as bv
+
+        if mode == "auto":
+            from lodestar_tpu.ops import fp_pallas
+
+            if not fp_pallas.use_pallas():
+                return _single()
+        n = bv.mesh_device_count()
+        if n <= 1:
+            return _single()
+        lanes = [
+            MeshLane(i, bv.make_lane_verify_fn(i), wedge_threshold=wedge_threshold)
+            for i in range(n)
+        ]
+        return VerifierMesh(lanes, sharded_fn=bv.make_mesh_sharded_fn())
+    except Exception:
+        # enumeration failures must not take the verifier down — serve
+        # on the single-device path the pool always supported
+        return _single()

@@ -18,11 +18,21 @@ N-worker thread pool replaced by one device pipeline:
 * **Fail-closed** (`index.ts:386-393` analogue): any backend error rejects
   the job with the error — it never resolves True. Callers treat rejection
   as invalid-block/peer-downscore, exactly like the reference.
-* **Wedge detection** (`offload/resilience.CircuitBreaker`): consecutive
-  backend errors open a device breaker and `can_accept_work()` goes
-  False — a wedged device (driver hang, OOM loop) stops attracting work
-  and a `DegradingBlsVerifier` skips the pool without paying one failed
-  launch per call; after the reset delay the pool self-offers again.
+* **Mesh lanes** (`chain/bls/mesh.py`): the pool serves a `VerifierMesh`
+  of per-device launch lanes. One dispatcher waits for a free lane,
+  dequeues through the shared priority queue, and places the package:
+  latency-class work goes to the least-occupied free chip; bulk
+  range-sync/backfill batches big enough to amortize a collective go
+  data-parallel (`verify_signature_sets_sharded`) across the idle chips.
+  With a single visible device the mesh is one lane and the launch
+  schedule is bit-identical to the pre-mesh pool (regression-tested).
+* **Wedge detection** (`offload/resilience.CircuitBreaker`): each lane
+  carries its OWN wedge breaker — consecutive launch errors on a chip
+  open it, the dispatcher stops placing work there, and in-flight work
+  retries on a sibling lane, so one sick device degrades the pool to an
+  (N-1)-chip mesh. Only when EVERY lane is wedged does the pool report
+  is_down() and the degradation chain routes around it; after the reset
+  delay a wedged lane self-offers again.
 * **Admission** (`index.ts:143-149`): can_accept_work() false once
   MAX_JOBS_CAN_ACCEPT_WORK (512) jobs are outstanding — backpressure
   signal for the gossip processor.
@@ -32,14 +42,16 @@ N-worker thread pool replaced by one device pipeline:
   instead of FIFO, so a slot-deadline block never queues behind a
   backfill batch. Bulk-class jobs run one per package — the bound on
   how long they can head-of-line-block an arriving urgent job. Device
-  launches feed an EWMA occupancy tracker (busy-ns/wall-ns) and a
-  graded ACCEPT/SHED_BULK/REJECT admission view the offload server
-  ships to clients. `scheduler_enabled=False` restores arrival order
-  (the control arm for the saturation tests).
+  launches feed per-lane EWMA occupancy trackers whose mesh aggregate
+  backs a graded ACCEPT/SHED_BULK/REJECT admission view the offload
+  server ships to clients. `scheduler_enabled=False` restores arrival
+  order (the control arm for the saturation tests).
 
 The verify backend is injected as a callable (default: the device model
 `models.batch_verify.verify_signature_sets_device`), which keeps the seam
-mockable and lets tests drive the retry paths deterministically.
+mockable and lets tests drive the retry paths deterministically; passing
+an explicit callable pins the pool to a single lane (a mock cannot be
+enumerated per device). Tests inject multi-lane topologies via `mesh=`.
 """
 
 from __future__ import annotations
@@ -54,13 +66,20 @@ from lodestar_tpu.logger import get_logger
 from lodestar_tpu.scheduler import (
     BULK_CLASSES,
     AdmissionController,
-    AdmissionState,
-    OccupancyTracker,
     PriorityClass,
     PriorityWorkQueue,
 )
 
 from .interface import IBlsVerifier, VerifySignatureOpts
+from .mesh import (
+    LANE_WEDGE_THRESHOLD,
+    MESH_MODES,
+    SHARD_MIN_SETS_PER_LANE,
+    MeshLane,
+    VerifierMesh,
+    build_device_mesh,
+    single_lane_mesh,
+)
 
 __all__ = [
     "BlsDeviceVerifierPool",
@@ -78,10 +97,11 @@ MAX_BUFFERED_SIGS = 32
 MAX_BUFFER_WAIT_MS = 100
 MAX_JOBS_CAN_ACCEPT_WORK = 512
 BATCHABLE_MIN_PER_CHUNK = 16  # worker.ts:11-17
-# consecutive backend errors before the pool reports itself wedged
-# (can_accept_work False) — high enough that one bad batch + its retries
-# can't trip it, low enough to stop a launch storm against a hung driver
-DEVICE_WEDGE_THRESHOLD = 8
+# consecutive backend errors before ONE LANE reports itself wedged —
+# the pre-mesh pool-wide threshold carried over per chip. THE value
+# lives in mesh.py (LANE_WEDGE_THRESHOLD, shared with the standalone
+# offload host); this alias keeps the pre-mesh export name
+DEVICE_WEDGE_THRESHOLD = LANE_WEDGE_THRESHOLD
 # sets per launch package under the scheduler: a queued attestation
 # flood must not coalesce into one giant package that head-of-line
 # blocks an arriving gossip block for its whole duration
@@ -135,7 +155,10 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         scheduler_enabled: bool = True,
         aging_ms: float | None = None,
         sched_metrics=None,
+        mesh: VerifierMesh | None = None,
+        mesh_mode: str | None = None,
     ) -> None:
+        explicit_fn = verify_fn is not None
         if verify_fn is None:
             from lodestar_tpu.models.batch_verify import verify_signature_sets_device
 
@@ -144,15 +167,24 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         self._buffer_wait_ms = buffer_wait_ms
         self._max_buffered_sigs = max_buffered_sigs
         self._log = get_logger(name="lodestar.bls-pool")
-        # wedge detection: consecutive launch errors open it, a success
-        # (or the reset delay elapsing) re-offers the pool for work
-        from lodestar_tpu.offload.resilience import CircuitBreaker
 
-        self.device_breaker = CircuitBreaker(
-            failure_threshold=DEVICE_WEDGE_THRESHOLD,
-            reset_timeout_s=5.0,
-            max_reset_timeout_s=60.0,
-        )
+        # mesh construction: an injected mesh wins (tests/topologies);
+        # a mesh_mode builds from the device enumeration unless the
+        # caller pinned an explicit verify_fn (a mock can't be
+        # enumerated per device); default is the single-lane pre-mesh
+        # shape around verify_fn
+        if mesh is not None:
+            self.mesh = mesh
+        elif mesh_mode is not None and mesh_mode not in MESH_MODES:
+            raise ValueError(f"bls_mesh must be one of {MESH_MODES}, got {mesh_mode!r}")
+        elif mesh_mode in ("auto", "on") and not explicit_fn:
+            self.mesh = build_device_mesh(
+                mesh_mode, wedge_threshold=DEVICE_WEDGE_THRESHOLD
+            )
+        else:
+            self.mesh = single_lane_mesh(
+                verify_fn, wedge_threshold=DEVICE_WEDGE_THRESHOLD
+            )
 
         self.scheduler_enabled = scheduler_enabled
         self._sched_metrics = sched_metrics
@@ -160,9 +192,11 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         if aging_ms is not None:
             queue_kwargs["aging_ms"] = aging_ms
         self._jobs: PriorityWorkQueue = PriorityWorkQueue(**queue_kwargs)
-        self.occupancy = OccupancyTracker()
+        # the mesh IS the occupancy view: mean busy fraction over
+        # available lanes (one lane -> exactly the pre-mesh tracker)
+        self.occupancy = self.mesh
         self.admission = AdmissionController(
-            self.occupancy,
+            self.mesh,
             depth_fn=lambda: self._outstanding,
             shed_bulk_depth=MAX_JOBS_CAN_ACCEPT_WORK // 2,
             reject_depth=MAX_JOBS_CAN_ACCEPT_WORK,
@@ -174,31 +208,48 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             # pool reports decaying occupancy instead of freezing at the
             # last launch's value
             sched_metrics.occupancy_permille.set_function(
-                lambda: self.occupancy.occupancy_permille()
+                lambda: self.mesh.occupancy_permille()
             )
             sched_metrics.admission_state.set_function(lambda: int(self.admission.state()))
+            sched_metrics.mesh_lanes.set_function(lambda: len(self.mesh.available()))
+            for lane in self.mesh.lanes:
+                sched_metrics.lane_occupancy.labels(lane.label).set_function(
+                    lambda lane=lane: lane.occupancy.occupancy_permille()
+                )
         self._buffered: list[_Job] = []  # guarded by: event-loop (single-threaded)
         self._buffered_sigs = 0  # guarded by: event-loop (single-threaded)
         self._buffer_timer: asyncio.TimerHandle | None = None  # guarded by: event-loop (single-threaded)
         self._closed = False  # guarded by: event-loop (one-way flag; executor readers see it at worst one package late)
         self._runner: asyncio.Task | None = None  # guarded by: event-loop (single-threaded)
+        self._launch_tasks: set[asyncio.Task] = set()  # guarded by: event-loop (single-threaded)
+        self._lane_free = asyncio.Event()  # guarded by: event-loop (single-threaded)
+        self._lane_free.set()
 
         # metric counters (reference blsThreadPool.* taxonomy)
-        self.metrics = {  # guarded by: runner-serialized (one package in flight at a time; scrapers read stale-by-one)
+        self.metrics = {  # guarded by: advisory-only (incremented from executor threads under the GIL; scrapers read stale-by-one)
             "jobs_started": 0,
             "sig_sets_started": 0,
             "batch_retries": 0,
             "batch_sigs_success": 0,
             "errors": 0,
+            "sharded_launches": 0,
+            "sharded_fallbacks": 0,
         }
+
+    @property
+    def device_breaker(self):
+        """Back-compat alias: the first lane's wedge breaker (THE wedge
+        breaker on a single-lane pool)."""
+        return self.mesh.lanes[0].breaker
 
     # -- IBlsVerifier ---------------------------------------------------------
 
     def is_down(self) -> bool:
-        """Wedged device (breaker open) or closed — the degradation
+        """Every lane wedged (breaker open) or closed — the degradation
         chain routes around the pool; mere queue saturation is NOT down
-        (that's backpressure, handled by can_accept_work)."""
-        return self._closed or self.device_breaker.is_open
+        (that's backpressure, handled by can_accept_work). One wedged
+        chip out of N is NOT down: the mesh serves on the rest."""
+        return self._closed or not self.mesh.available()
 
     def can_accept_work(self) -> bool:
         return not self.is_down() and self._outstanding < MAX_JOBS_CAN_ACCEPT_WORK
@@ -241,6 +292,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         for job, _cls, _waited in self._jobs.drain():
             if not job.future.done():
                 job.future.set_exception(err)
+        self._lane_free.set()  # unblock a dispatcher parked on a busy mesh
         if self._runner is not None:
             self._runner.cancel()
             try:
@@ -248,6 +300,14 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             except asyncio.CancelledError:
                 pass
             self._runner = None
+        # in-flight launches: cancel the awaiting tasks (the executor
+        # threads run to completion and resolve futures thread-safe,
+        # exactly like the pre-mesh abandoned run_in_executor)
+        for t in list(self._launch_tasks):
+            t.cancel()
+        if self._launch_tasks:
+            await asyncio.gather(*self._launch_tasks, return_exceptions=True)
+        self._launch_tasks.clear()
 
     # -- queueing -------------------------------------------------------------
 
@@ -299,8 +359,64 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 {"class": cls.label, "sets": len(job.sets)},
             )
 
+    # -- lane placement --------------------------------------------------------
+
+    def _free_lanes(self) -> list[MeshLane]:
+        """Lanes eligible for a new package. While ANY healthy lane
+        exists, only healthy free lanes count — a busy-but-healthy mesh
+        makes the dispatcher WAIT rather than dispatch onto an idle
+        wedged chip (which would feed a launch storm into the hung
+        driver the breaker just isolated). Only when every lane is
+        wedged does the dispatcher place work on a sick chip: it fails
+        fast, tripping futures with the error — the pre-mesh
+        wedged-pool behavior, and how a wedged breaker earns its
+        half-open retrial."""
+        avail = self.mesh.available()
+        if avail:
+            return [lane for lane in avail if lane.inflight == 0]
+        return [lane for lane in self.mesh.lanes if lane.inflight == 0]
+
+    async def _wait_free_lane(self) -> None:
+        """Park the dispatcher until some lane can take a package. The
+        wait happens BEFORE the dequeue, so jobs stay in the priority
+        queue (and keep reordering under arriving urgent work) until
+        the mesh actually has capacity — with one lane this is exactly
+        the pre-mesh serialized schedule."""
+        while not self._free_lanes():
+            self._lane_free.clear()
+            await self._lane_free.wait()
+
+    def _pick_placement(
+        self, cls: PriorityClass, package: list[_Job], free: list[MeshLane]
+    ) -> tuple[str, list[MeshLane]]:
+        """("sharded", lanes) for a bulk package big enough to amortize
+        a collective launch over >=2 idle healthy chips; otherwise
+        ("single", [least-occupied free lane]). `free` is non-empty by
+        contract (the dispatcher re-waits when a lane wedges out from
+        under it). Sharded lane sets are occupancy-CHOSEN but
+        index-ORDERED: the sharded executable cache keys on device
+        order, so a canonical ordering keeps one compile per subset
+        instead of one per occupancy permutation."""
+        if (
+            self.scheduler_enabled
+            and cls in BULK_CLASSES
+            and self.mesh.sharding_available()
+        ):
+            healthy_free = [lane for lane in free if not lane.wedged]
+            n_sets = sum(len(j.sets) for j in package)
+            want = n_sets // SHARD_MIN_SETS_PER_LANE
+            if len(healthy_free) >= 2 and want >= 2:
+                chosen = sorted(healthy_free, key=lambda l: l.occupancy.occupancy())
+                picked = chosen[: min(len(chosen), want)]
+                return "sharded", sorted(picked, key=lambda l: l.index)
+        lane = min(free, key=lambda l: (l.wedged, l.occupancy.occupancy()))
+        return "single", [lane]
+
     async def _run_jobs(self) -> None:
         while not self._closed:
+            await self._wait_free_lane()
+            if self._closed:
+                return
             job, cls, waited_ns = await self._jobs.get()
             self._record_sched_dequeue(job, cls, waited_ns)
             package = [job]
@@ -319,21 +435,124 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                     self._record_sched_dequeue(*nxt)
                     package.append(nxt[0])
                     package_sets += len(nxt[0].sets)
+            # a package is now IN HAND: from here to create_task, any
+            # await must fail the package's futures on cancellation —
+            # close() only drains the queue, it cannot see this package
             try:
-                await asyncio.get_event_loop().run_in_executor(
-                    None, self._verify_package, package
-                )
-            except Exception as e:  # fail closed: reject, never resolve True
-                self.metrics["errors"] += len(package)
-                self._log.error(f"bls verify package failed: {e!r}")
+                while True:
+                    free = self._free_lanes()
+                    if free:
+                        break
+                    # a free lane wedged between the capacity check and
+                    # placement (a cross-lane retry on an executor
+                    # thread can trip any breaker): healthy lanes exist
+                    # but are busy — their in-flight completions set
+                    # _lane_free, so this wait always terminates
+                    self._lane_free.clear()
+                    await self._lane_free.wait()
+                    if self._closed:
+                        raise asyncio.CancelledError("bls pool closed")
+                mode, lanes = self._pick_placement(cls, package, free)
+            except asyncio.CancelledError:
+                err = asyncio.CancelledError("bls pool closed")
                 for j in package:
                     if not j.future.done():
-                        j.future.set_exception(e)
+                        j.future.set_exception(err)
+                raise
+            for lane in lanes:
+                lane.inflight += 1
+            task = asyncio.get_event_loop().create_task(
+                self._launch(package, mode, lanes)
+            )
+            self._launch_tasks.add(task)
+            task.add_done_callback(self._launch_tasks.discard)
 
-    def _verify_package(self, package: list[_Job]) -> None:
+    def _release_lanes_early(self, to_release: list[MeshLane], held: list[MeshLane]) -> None:
+        """Loop-side early release: the sharded fallback returns unused
+        lanes to the dispatcher before its (possibly long) single-lane
+        retry finishes. `held` is the launch's live accounting — the
+        finally below decrements exactly what is still held."""
+        for lane in to_release:
+            if lane in held:
+                held.remove(lane)
+                lane.inflight -= 1
+        self._lane_free.set()
+
+    async def _launch(self, package: list[_Job], mode: str, lanes: list[MeshLane]) -> None:
+        held = list(lanes)  # guarded by: event-loop (early releases and the finally both run on the loop)
+        try:
+            if mode == "sharded":
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._verify_package_sharded, package, lanes, held
+                )
+            else:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._verify_package, package, lanes[0]
+                )
+        except asyncio.CancelledError:
+            # close() cancels launch tasks; if the executor work item
+            # had not STARTED yet it never runs and nobody else will
+            # resolve these futures — fail them closed (done futures,
+            # resolved by an already-running executor thread, no-op)
+            err = asyncio.CancelledError("bls pool closed")
+            for j in package:
+                if not j.future.done():
+                    j.future.set_exception(err)
+            raise
+        except Exception as e:  # fail closed: reject, never resolve True
+            self.metrics["errors"] += len(package)
+            self._log.error(f"bls verify package failed: {e!r}")
+            for j in package:
+                if not j.future.done():
+                    j.future.set_exception(e)
+        finally:
+            for lane in held:
+                lane.inflight -= 1
+            # clear so a LATE _release_lanes_early (scheduled by an
+            # executor thread that outlives a cancelled launch task)
+            # finds nothing left to double-decrement
+            held.clear()
+            self._lane_free.set()
+
+    # -- device launches (executor threads) ------------------------------------
+
+    def _on_lane_wedge(self, lane: MeshLane) -> None:
+        """closed->open transition on one chip's wedge breaker."""
+        self._log.warn(
+            "device lane wedged, degrading to remaining chips",
+            {"device": lane.label, "lanes_left": len(self.mesh.available())},
+        )
+        m = self._sched_metrics
+        if m is not None:
+            m.lane_wedge_trips.labels(lane.label).inc()
+
+    def _count_lane_launch(self, lane: MeshLane, mode: str) -> None:
+        m = self._sched_metrics
+        if m is not None:
+            m.lane_launches.labels(lane.label, mode).inc()
+
+    def _launch_sets(self, lane: MeshLane, sets: list[SignatureSet]):
+        """One verify launch, preferring `lane` (mesh_launch: breaker
+        accounting + cross-lane error retry — a sick chip degrades its
+        work onto the rest of the mesh with the verdict unchanged;
+        raises only when every candidate lane errored, which with one
+        lane is exactly the pre-mesh fail-closed behavior). Returns
+        (ok, lane_that_served)."""
+        from .mesh import mesh_launch
+
+        return mesh_launch(
+            self.mesh,
+            sets,
+            prefer=lane,
+            on_launch=lambda l: self._count_lane_launch(l, "single"),
+            on_wedge=self._on_lane_wedge,
+        )
+
+    def _verify_package(self, package: list[_Job], lane: MeshLane, counted: bool = False) -> None:
         """Runs in a thread executor (device dispatch releases the GIL)."""
-        self.metrics["jobs_started"] += len(package)
-        self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
+        if not counted:
+            self.metrics["jobs_started"] += len(package)
+            self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
 
         # tracing work (incl. the clock reads) only when some job in the
         # package was submitted under an active trace — the disabled path
@@ -361,20 +580,18 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             all_sets = [s for j in chunk for s in j.sets]
             t0 = time.monotonic_ns() if traced else 0
             try:
-                with trace_region("bls_batch_verify"), self.occupancy.launch():
-                    ok = self._verify_fn(all_sets)
-                self.device_breaker.record_success()
+                with trace_region("bls_batch_verify"):
+                    ok, served = self._launch_sets(lane, all_sets)
             except Exception:
-                self.device_breaker.record_failure()
                 self.metrics["batch_retries"] += 1
                 if traced:
                     self._trace_prep(chunk, t0)
-                    self._trace_launch(chunk, t0, len(all_sets), "batch_error")
+                    self._trace_launch(chunk, t0, len(all_sets), "batch_error", lane.label)
                 individual.extend(chunk)
                 continue
             if traced:
                 self._trace_prep(chunk, t0)
-                self._trace_launch(chunk, t0, len(all_sets), "batch")
+                self._trace_launch(chunk, t0, len(all_sets), "batch", served.label)
             if ok:
                 self.metrics["batch_sigs_success"] += len(all_sets)
                 for j in chunk:
@@ -386,20 +603,102 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         for j in individual:
             t0 = time.monotonic_ns() if traced else 0
             try:
-                with self.occupancy.launch():
-                    ok = self._verify_fn(j.sets)
-                self.device_breaker.record_success()
+                ok, served = self._launch_sets(lane, j.sets)
                 if traced:
                     self._trace_prep([j], t0)
-                    self._trace_launch([j], t0, len(j.sets), "single")
+                    self._trace_launch([j], t0, len(j.sets), "single", served.label)
                 self._resolve(j, ok)
             except Exception as e:
-                self.device_breaker.record_failure()
                 if traced:
                     self._trace_prep([j], t0)
-                    self._trace_launch([j], t0, len(j.sets), "single_error")
+                    self._trace_launch([j], t0, len(j.sets), "single_error", lane.label)
                 if not j.future.done():
                     j.future.get_loop().call_soon_threadsafe(self._reject, j, e)
+
+    def _verify_package_sharded(
+        self, package: list[_Job], lanes: list[MeshLane], held: list[MeshLane] | None = None
+    ) -> None:
+        """One data-parallel launch over idle lanes (executor thread).
+        A collective ERROR cannot name the sick chip, so it feeds the
+        mesh's sharded breaker (parking the collective path) and the
+        package degrades to the attributable single-lane path; an
+        invalid VERDICT takes the same retry road the RLC batch does —
+        re-verified per job so one bad signature can't poison its
+        package (and so a lying collective can't be weaker than the
+        single-device policy)."""
+        self.metrics["jobs_started"] += len(package)
+        self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
+        all_sets = [s for j in package for s in j.sets]
+        traced = any(j.trace_parent is not None for j in package)
+        if traced:
+            launch_ns = time.monotonic_ns()
+            for j in package:
+                if j.trace_parent is not None:
+                    tracing.record(
+                        j.trace_parent, "bls_buffer_wait", j.added_ns, launch_ns,
+                        {"sets": len(j.sets)},
+                    )
+        t0 = time.monotonic_ns() if traced else 0
+        import contextlib
+
+        try:
+            with contextlib.ExitStack() as stack:
+                for lane in lanes:
+                    stack.enter_context(lane.occupancy.launch())
+                ok = bool(
+                    self.mesh.sharded_fn(all_sets, [lane.index for lane in lanes])
+                )
+            self.mesh.sharded_breaker.record_success()
+            self.metrics["sharded_launches"] += 1
+            for lane in lanes:
+                lane.launches += 1
+                self._count_lane_launch(lane, "sharded")
+        except Exception:
+            self.mesh.sharded_breaker.record_failure()
+            self.metrics["sharded_fallbacks"] += 1
+            self.metrics["batch_retries"] += 1
+            if traced:
+                self._trace_launch(
+                    package, t0, len(all_sets), "sharded_error",
+                    ",".join(lane.label for lane in lanes),
+                )
+            fallback = min(lanes, key=lambda l: l.occupancy.occupancy())
+            self._release_unused(lanes, fallback, held, package)
+            self._verify_package(package, fallback, counted=True)
+            return
+        if traced:
+            self._trace_launch(
+                package, t0, len(all_sets), "sharded",
+                ",".join(lane.label for lane in lanes),
+            )
+        if ok:
+            self.metrics["batch_sigs_success"] += len(all_sets)
+            for j in package:
+                self._resolve(j, True)
+        else:
+            self.metrics["batch_retries"] += 1
+            fallback = min(lanes, key=lambda l: l.occupancy.occupancy())
+            self._release_unused(lanes, fallback, held, package)
+            self._verify_package(package, fallback, counted=True)
+
+    def _release_unused(
+        self,
+        lanes: list[MeshLane],
+        fallback: MeshLane,
+        held: "list[MeshLane] | None",
+        package: list[_Job],
+    ) -> None:
+        """Executor-side entry to the loop-side early release: the
+        sharded fallback keeps ONE lane for its (possibly long)
+        single-lane retry — the other chips go back to the dispatcher
+        now instead of idling behind this package's finally."""
+        if held is None:
+            return
+        unused = [lane for lane in lanes if lane is not fallback]
+        if unused:
+            package[0].future.get_loop().call_soon_threadsafe(
+                self._release_lanes_early, unused, held
+            )
 
     @staticmethod
     def _trace_prep(jobs: list[_Job], launch_start_ns: int) -> None:
@@ -429,12 +728,15 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 )
 
     @staticmethod
-    def _trace_launch(jobs: list[_Job], start_ns: int, n_sets: int, mode: str) -> None:
+    def _trace_launch(
+        jobs: list[_Job], start_ns: int, n_sets: int, mode: str, device: str = "dev0"
+    ) -> None:
         """Per-traced-job device-launch span; a batch covering jobs from
         several traces lands one identically-timed span in each. A
         batchable job verified in the single pass got there because its
         batch failed — that's the reference's batch-then-retry path, so
-        it's labeled bls_batch_retry to keep the decomposition visible."""
+        it's labeled bls_batch_retry to keep the decomposition visible.
+        The serving lane rides along as the `device` attribute."""
         end_ns = time.monotonic_ns()
         for j in jobs:
             if j.trace_parent is not None:
@@ -444,7 +746,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                     "bls_batch_retry" if retried else "bls_device_launch",
                     start_ns,
                     end_ns,
-                    {"sets": n_sets, "mode": mode},
+                    {"sets": n_sets, "mode": mode, "device": device},
                 )
 
     def _resolve(self, job: _Job, result: bool) -> None:

@@ -69,6 +69,20 @@ def _add_scheduler_args(sp) -> None:
         "when the Pallas backend is live, on = always, off = CPU "
         "incremental hashing. Device errors fall back to the CPU path.",
     )
+    sp.add_argument(
+        "--bls-mesh", choices=["auto", "on", "off"], default="auto",
+        help="serve the local BLS verifier pool on the full device mesh: "
+        "per-chip launch lanes (latency work to the least-occupied chip, "
+        "bulk sharded data-parallel across idle chips, per-chip wedge "
+        "breakers). auto = only when the Pallas backend is live and more "
+        "than one device is visible; off = the single-device pool.",
+    )
+    sp.add_argument(
+        "--offload-tenant", default=None, metavar="NAME",
+        help="tenant identity stamped onto offload verify frames (multi-"
+        "tenant serving hosts apply per-tenant quotas and stride-fair "
+        "scheduling to it; omitted = the server's default tenant)",
+    )
     from lodestar_tpu.offload.resilience import (
         DEFAULT_FAILURE_THRESHOLD,
         DEFAULT_MAX_RESET_TIMEOUT_S,
@@ -339,6 +353,8 @@ async def _run_dev(args) -> int:
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
             htr_device=args.htr_device,
+            bls_mesh=args.bls_mesh,
+            offload_tenant=args.offload_tenant,
         ),
         p=p,
         time_fn=lambda: now[0],
@@ -505,6 +521,8 @@ async def _run_beacon(args) -> int:
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
             htr_device=args.htr_device,
+            bls_mesh=args.bls_mesh,
+            offload_tenant=args.offload_tenant,
         ),
         p=p,
         db=db,

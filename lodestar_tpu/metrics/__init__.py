@@ -33,6 +33,8 @@ __all__ = [
     "SchedulerMetrics",
     "ResilienceMetrics",
     "AuditMetrics",
+    "TenantMetrics",
+    "create_tenant_metrics",
     "create_metrics",
     "MetricsServer",
     "ValidatorMonitor",
@@ -272,9 +274,13 @@ class SchedulerMetrics:
     queue_wait: Histogram  # labeled by launch class
     jobs_dequeued: Counter  # labeled by launch class
     starvation_promotions: Counter
-    occupancy_permille: Gauge
+    occupancy_permille: Gauge  # mesh aggregate over available lanes
     admission_state: Gauge  # 0 accept / 1 shed_bulk / 2 reject
     shed_total: Counter  # labeled by launch class
+    lane_occupancy: Gauge  # per-device EWMA occupancy, labeled by device
+    lane_launches: Counter  # device launches, labeled by device + mode (single/sharded)
+    lane_wedge_trips: Counter  # per-chip wedge-breaker trips, labeled by device
+    mesh_lanes: Gauge  # non-wedged lanes currently serving
 
 
 @dataclass
@@ -312,6 +318,49 @@ class AuditMetrics:
     quarantined: Gauge  # 1 while the endpoint is quarantined
     queue_depth: Gauge  # audit queue backlog
     cpu_seconds: Counter  # audit re-verification CPU time (budget accounting)
+
+
+@dataclass
+class TenantMetrics:
+    """lodestar_offload_tenant_* — the offload server's multi-tenant
+    front-end (`offload/tenancy.py`): per-tenant admitted/served work,
+    quota sheds by reason, in-flight grants and configured stride
+    weights. Registered by the serving host (`create_tenant_metrics`),
+    not the beacon node — the node is a tenant, the server meters them."""
+
+    served_sets: Counter  # signature sets served, labeled by tenant
+    shed: Counter  # admission sheds, labeled by tenant + reason (quota/slot_timeout)
+    inflight: Gauge  # granted service slots, labeled by tenant
+    quota_weight: Gauge  # configured stride weight, labeled by tenant
+
+
+def create_tenant_metrics(creator: "RegistryMetricCreator | None" = None) -> TenantMetrics:
+    """Tenant families for an offload serving host (its own registry by
+    default — the server runs in its own process)."""
+    c = creator or RegistryMetricCreator()
+    return TenantMetrics(
+        served_sets=c.counter(
+            "lodestar_offload_tenant_served_sets_total",
+            "Signature sets served per tenant",
+            ["tenant"],
+        ),
+        shed=c.counter(
+            "lodestar_offload_tenant_shed_total",
+            "Admission sheds per tenant (quota = depth grading, "
+            "slot_timeout = stride queue wait expired)",
+            ["tenant", "reason"],
+        ),
+        inflight=c.gauge(
+            "lodestar_offload_tenant_inflight",
+            "Granted service slots per tenant",
+            ["tenant"],
+        ),
+        quota_weight=c.gauge(
+            "lodestar_offload_tenant_quota_weight",
+            "Configured stride-fair service weight per tenant",
+            ["tenant"],
+        ),
+    )
 
 
 @dataclass
@@ -886,6 +935,25 @@ def create_metrics() -> BeaconMetrics:
         ),
         shed_total=c.counter(
             "lodestar_sched_shed_total", "Work deferred by backpressure/admission", ["class"]
+        ),
+        lane_occupancy=c.gauge(
+            "lodestar_sched_lane_occupancy_permille",
+            "Per-chip EWMA busy-ns per wall-ns (0-1000)",
+            ["device"],
+        ),
+        lane_launches=c.counter(
+            "lodestar_sched_lane_launches_total",
+            "Device launches per mesh lane (mode: single or sharded collective)",
+            ["device", "mode"],
+        ),
+        lane_wedge_trips=c.counter(
+            "lodestar_sched_lane_wedge_trips_total",
+            "Per-chip wedge-breaker trips (lane degraded out of the mesh)",
+            ["device"],
+        ),
+        mesh_lanes=c.gauge(
+            "lodestar_sched_mesh_lanes_available",
+            "Mesh lanes currently serving (non-wedged)",
         ),
     )
     return BeaconMetrics(

@@ -60,6 +60,9 @@ __all__ = [
     "make_synthetic_sets",
     "verify_signature_sets_device",
     "verify_signature_sets_sharded",
+    "mesh_device_count",
+    "make_lane_verify_fn",
+    "make_mesh_sharded_fn",
 ]
 
 COEFF_BITS = 64  # blinding scalar width, matches blst's 64-bit rand coeffs
@@ -726,3 +729,53 @@ def verify_signature_sets_sharded(sets: list[SignatureSet], mesh) -> bool:
         return False
     pk, h, sig, bits, mask = inputs
     return bool(np.asarray(device_batch_verify_sharded(mesh, pk, h, sig, bits, mask)))
+
+
+# --- mesh serving helpers (chain/bls/mesh.py construction seam) ---------------
+
+
+def mesh_device_count() -> int:
+    """Visible accelerator device count (0 when enumeration fails) —
+    the production input to `build_device_mesh`."""
+    try:
+        return len(jax.devices())
+    except Exception:
+        return 0
+
+
+def make_lane_verify_fn(device_index: int):
+    """Single-device verify callable pinned to one chip: the per-lane
+    backend of the mesh pool. Placement rides `jax.default_device`, so
+    each lane compiles/launches against its own die while sharing the
+    host-side prep and the per-size-class program cache."""
+
+    def lane_verify(sets: list[SignatureSet]) -> bool:
+        dev = jax.devices()[device_index]
+        with jax.default_device(dev):
+            return verify_signature_sets_device(sets)
+
+    lane_verify.__name__ = f"lane_verify_dev{device_index}"
+    return lane_verify
+
+
+def make_mesh_sharded_fn():
+    """Collective verify callable over a lane subset: builds the jax
+    Mesh for the given device indices and runs the data-parallel
+    program. One executable is compiled (and memoized, see
+    device_batch_verify_sharded) per (device subset, batch size)."""
+
+    def sharded_verify(sets: list[SignatureSet], device_indices) -> bool:
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        # canonical device order: the sharded-executable memo keys on
+        # the device tuple, and the data-parallel verdict is order-
+        # invariant — an occupancy-ordered subset must not recompile
+        # the minutes-long program once per permutation
+        picked = [devs[i] for i in sorted(device_indices)]
+        if len(picked) < 2:
+            raise ValueError("sharded verify needs at least two devices")
+        mesh = Mesh(np.asarray(picked), ("data",))
+        return verify_signature_sets_sharded(sets, mesh)
+
+    return sharded_verify

@@ -55,6 +55,8 @@ class BeaconNodeOptions:
         scheduler_enabled: bool = True,
         bls_device_prep: str = "auto",
         htr_device: str = "auto",
+        bls_mesh: str = "auto",
+        offload_tenant: str | None = None,
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -152,6 +154,27 @@ class BeaconNodeOptions:
                 f"htr_device must be one of {HTR_MODES}, got {htr_device!r}"
             )
         self.htr_device = htr_device
+        # verifier mesh placement (chain/bls/mesh.py): "auto" serves the
+        # local pool on per-chip launch lanes only when the Pallas
+        # backend is live and >1 device is visible; "on"/"off" force.
+        # A wedged chip degrades the pool to the remaining lanes.
+        from lodestar_tpu.chain.bls.mesh import MESH_MODES
+
+        if bls_mesh not in MESH_MODES:
+            raise ValueError(f"bls_mesh must be one of {MESH_MODES}, got {bls_mesh!r}")
+        self.bls_mesh = bls_mesh
+        # tenant identity for the offload client (multi-tenant serving
+        # hosts meter quotas and stride-fair shares per tenant) —
+        # validated here so a config typo is a startup error, not a
+        # per-verify offload outage
+        if offload_tenant is not None:
+            from lodestar_tpu.offload import validate_tenant
+
+            try:
+                validate_tenant(offload_tenant)
+            except Exception as e:
+                raise ValueError(f"offload_tenant: {e}") from e
+        self.offload_tenant = offload_tenant
 
 
 class BeaconNode:
@@ -308,6 +331,7 @@ class BeaconNode:
                 metrics=metrics.resilience,
                 auditor=auditor,
                 quarantine_cooloff_s=opts.offload_quarantine_cooloff_s or None,
+                tenant=opts.offload_tenant,
             )
             if opts.offload_audit_via == "helper" and len(opts.offload_endpoints) > 1:
                 from lodestar_tpu.offload.audit import cross_helper_reference
@@ -359,6 +383,7 @@ class BeaconNode:
                             BlsDeviceVerifierPool(
                                 scheduler_enabled=opts.scheduler_enabled,
                                 sched_metrics=metrics.sched,
+                                mesh_mode=opts.bls_mesh,
                             ),
                         )
                     )
@@ -368,7 +393,9 @@ class BeaconNode:
             from lodestar_tpu.chain.bls import BlsDeviceVerifierPool
 
             bls = BlsDeviceVerifierPool(
-                scheduler_enabled=opts.scheduler_enabled, sched_metrics=metrics.sched
+                scheduler_enabled=opts.scheduler_enabled,
+                sched_metrics=metrics.sched,
+                mesh_mode=opts.bls_mesh,
             )
         else:
             bls = BlsSingleThreadVerifier()

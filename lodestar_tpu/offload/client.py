@@ -44,13 +44,28 @@ trial's success.
 Byzantine auditing (`offload/audit.py`): when an `OffloadAuditor` is
 attached, every offload-served verdict is offered to its seeded sampler
 (one coin flip + a non-blocking queue put — the hot path never waits on
-re-verification) and routing becomes trust-aware: endpoints whose audit
-trust EWMA fell below `TRUST_ROUTE_THRESHOLD` serve only when no
-trusted endpoint is viable, and a QUARANTINED endpoint (caught lying by
-the auditor's independent re-check) is skipped like any circuit-open
-endpoint — but its breaker ignores probe recoveries until the cool-off
-elapses or `unquarantine_endpoint` (the `--offload-unquarantine` admin
-action) lifts it.
+re-verification) and routing becomes trust-aware: the trust EWMA folds
+CONTINUOUSLY into the occupancy rank (`_occupancy_key`) — every
+contradiction shifts load away gradually, and at trust below
+`TRUST_ROUTE_THRESHOLD` the penalty exceeds the whole occupancy scale,
+so a sub-threshold endpoint serves only when every trusted sibling is
+pinned or gone (the old binary demotion as the limit case). A
+QUARANTINED endpoint (caught lying by the auditor's independent
+re-check) is skipped like any circuit-open endpoint — but its breaker
+ignores probe recoveries until the cool-off elapses or
+`unquarantine_endpoint` (the `--offload-unquarantine` admin action)
+lifts it.
+
+Multi-tenant + fleet routing (PR 8): `tenant=` stamps the client's
+identity (and the job's launch class) onto verify frames toward
+servers that advertised the capability, so the host's per-tenant
+quotas and stride-fair scheduling attach to wire identity. The Status
+mesh trailer feeds routing a FLEET view: occupancy is the server's
+healthy-chip aggregate and in-flight work is normalized by advertised
+chip capacity, so one 8-chip host outranks a single-die host at equal
+die load. A server-side admission shed (`OffloadShed`) fails the job
+closed but does NOT charge the endpoint's breaker — hedge-class work
+immediately fails over to a sibling.
 """
 
 from __future__ import annotations
@@ -67,7 +82,15 @@ from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.logger import get_logger
 from lodestar_tpu.scheduler import BULK_CLASSES, AdmissionState, PriorityClass
 
-from . import OffloadError, decode_status, decode_verdict, encode_sets
+from . import (
+    OffloadError,
+    OffloadShed,
+    decode_status,
+    decode_verdict,
+    encode_sets,
+    encode_tenant_trailer,
+    validate_tenant,
+)
 from .audit import TRUST_ROUTE_THRESHOLD
 from .resilience import (
     CLASS_DEADLINE_S,
@@ -122,6 +145,9 @@ class _Endpoint:
         "breaker",
         "digest_seen",
         "was_quarantined",
+        "capacity",
+        "chips_wedged",
+        "tenant_capable",
     )
 
     def __init__(self, target: str, breaker: CircuitBreaker):
@@ -144,6 +170,15 @@ class _Endpoint:
         # rehabilitation cleanup so a fresh CLOSED endpoint at startup
         # can't wipe a persisted record before the node re-applies it
         self.was_quarantined = False  # guarded by: _lock [shared]
+        # fleet view from the Status mesh trailer: advertised serving
+        # capacity in chips (wedged chips dropped), wedged-chip count,
+        # and whether verify frames may carry the tenant trailer.
+        # tenant_capable is STICKY one-way like digest_seen: once the
+        # server advertised it, a bare probe (or downgrade) must not
+        # strip tenant identity off subsequent frames
+        self.capacity = 1  # guarded by: _lock [shared]
+        self.chips_wedged = 0  # guarded by: _lock [shared]
+        self.tenant_capable = False  # guarded by: _lock [shared]
 
     def state(self) -> dict:  # lint: allow(lock-discipline) — sole caller is endpoint_states(), which holds the owning client's _lock
         return {
@@ -155,14 +190,33 @@ class _Endpoint:
             "admission": self.admission.label,
             "extended": self.extended,
             "breaker": self.breaker.state().label,
+            "capacity": self.capacity,
+            "chips_wedged": self.chips_wedged,
+            "tenant_capable": self.tenant_capable,
         }
 
 
-def _occupancy_key(ep: _Endpoint) -> tuple[int, int]:  # lint: allow(lock-discipline) — sort key for _pick_endpoint, which holds the client's _lock
-    return (
-        ep.occupancy_permille if ep.occupancy_permille is not None else _UNKNOWN_OCCUPANCY,
-        ep.outstanding,
+#: permille-scale routing penalty at zero trust. Derived from the route
+#: threshold so the continuous fold preserves the old binary demotion in
+#: the limit: at trust == TRUST_ROUTE_THRESHOLD the penalty equals the
+#: full occupancy scale (1000) — a sub-threshold endpoint ranks behind
+#: ANY fully-trusted endpoint, however loaded — while trust between the
+#: threshold and 1.0 shifts load away GRADUALLY as contradictions
+#: accumulate instead of at a cliff.
+TRUST_PENALTY_SPAN = int(round(1000.0 / (1.0 - TRUST_ROUTE_THRESHOLD)))
+
+
+def _occupancy_key(ep: _Endpoint, trust: float = 1.0) -> tuple[int, int]:  # lint: allow(lock-discipline) — sort key for _pick_endpoint, which holds the client's _lock
+    """Routing rank: fleet occupancy + continuous trust penalty first,
+    then in-flight jobs normalized by the endpoint's advertised chip
+    capacity — an 8-chip host with 8 outstanding jobs has the headroom
+    of a single-die host with 1."""
+    occ = (
+        ep.occupancy_permille if ep.occupancy_permille is not None else _UNKNOWN_OCCUPANCY
     )
+    penalty = int((1.0 - max(0.0, min(1.0, trust))) * TRUST_PENALTY_SPAN)
+    cap = max(1, ep.capacity)
+    return (occ + penalty, (ep.outstanding * 1000) // cap)
 
 
 class BlsOffloadClient(IBlsVerifier):
@@ -182,6 +236,7 @@ class BlsOffloadClient(IBlsVerifier):
         transport_wrapper=None,
         auditor=None,
         quarantine_cooloff_s: float | None = DEFAULT_QUARANTINE_COOLOFF_S,
+        tenant: str | None = None,
     ) -> None:
         targets = [target] if isinstance(target, str) else list(target)
         if not targets:
@@ -204,6 +259,14 @@ class BlsOffloadClient(IBlsVerifier):
         # the endpoint through the callback bound here
         self._auditor = auditor
         self.quarantine_cooloff_s = quarantine_cooloff_s
+        # multi-tenant identity stamped onto verify frames — but only
+        # toward endpoints whose Status advertised the capability, so a
+        # legacy server keeps seeing bit-exact legacy frames. Validated
+        # HERE: a bad identity (empty, >255 bytes) must be a startup
+        # error, not a per-verify outage
+        if tenant is not None:
+            validate_tenant(tenant)
+        self.tenant = tenant
         if auditor is not None:
             auditor.bind(self.quarantine_endpoint)
         self._class_deadlines = dict(class_deadlines or CLASS_DEADLINE_S)
@@ -305,6 +368,12 @@ class BlsOffloadClient(IBlsVerifier):
             ep.occupancy_permille = frame.occupancy_permille
             ep.queue_depth = frame.queue_depth
             ep.extended = frame.extended
+            # fleet view: a wedged/quarantined chip drops out of the
+            # advertised capacity within one probe interval
+            ep.capacity = frame.capacity
+            ep.chips_wedged = sum(1 for c in frame.chips if c.wedged)
+            if frame.tenant_capable:
+                ep.tenant_capable = True  # sticky, like digest_seen
         if not was_healthy and frame.can_accept:
             self.log.info(f"offload service {ep.target} is back")
         # the quarantine gauge is event-driven on entry but a cool-off
@@ -398,11 +467,13 @@ class BlsOffloadClient(IBlsVerifier):
         endpoint plus the breaker generation token its admission handed
         out, so the RPC's outcome is matched to this exact attempt.
 
-        Trust-aware: with an auditor attached, endpoints whose audit
-        trust fell below `TRUST_ROUTE_THRESHOLD` are demoted — they
-        serve only when no trusted candidate is viable. (Quarantine
-        handles the caught-lying case outright; low trust covers the
-        gray zone of arbitrated helper-vs-helper disagreements.)
+        Trust-aware: with an auditor attached, the trust EWMA folds
+        continuously into the occupancy rank — load shifts away
+        gradually as contradictions accumulate, and a sub-threshold
+        endpoint serves only when every trusted candidate is pinned or
+        gone. (Quarantine handles the caught-lying case outright; low
+        trust covers the gray zone of arbitrated helper-vs-helper
+        disagreements.)
 
         Recovery: an OPEN endpoint whose reset delay elapsed gets its
         half-open trial EVEN while closed endpoints exist — otherwise a
@@ -432,11 +503,6 @@ class BlsOffloadClient(IBlsVerifier):
             if closed:
                 healthy = [ep for ep in closed if ep.healthy]
                 cands = [ep for ep in healthy if ep.admission is not AdmissionState.REJECT]
-                trusted = [
-                    ep for ep in cands if self._trust(ep.target) >= TRUST_ROUTE_THRESHOLD
-                ]
-                if trusted:
-                    cands = trusted
                 if priority in BULK_CLASSES:
                     accepting = [
                         ep for ep in cands if ep.admission is AdmissionState.ACCEPT
@@ -448,10 +514,16 @@ class BlsOffloadClient(IBlsVerifier):
                 # the chosen breaker can open between the state() read
                 # and acquisition (outcomes land without the client
                 # lock): retry the NEXT-best candidate so the healthy/
-                # admission/trust filters still hold, rather than
-                # falling straight to the unfiltered trial scan
+                # admission filters still hold, rather than falling
+                # straight to the unfiltered trial scan. Trust folds
+                # into the rank CONTINUOUSLY (see _occupancy_key):
+                # contradictions shift load away gradually, and a
+                # sub-threshold endpoint serves only when every
+                # fully-trusted sibling is pinned or gone.
                 while cands:
-                    best = min(cands, key=_occupancy_key)
+                    best = min(
+                        cands, key=lambda e: _occupancy_key(e, self._trust(e.target))
+                    )
                     token = best.breaker.try_acquire()
                     if token is not None:
                         return best, token
@@ -459,7 +531,9 @@ class BlsOffloadClient(IBlsVerifier):
             # no closed breaker admitted work: probe the least-loaded
             # endpoint that admits a half-open trial (try_acquire
             # consumes the slot)
-            for ep in sorted(pool, key=_occupancy_key):
+            for ep in sorted(
+                pool, key=lambda e: _occupancy_key(e, self._trust(e.target))
+            ):
                 token = ep.breaker.try_acquire()
                 if token is not None:
                     return ep, token
@@ -541,12 +615,21 @@ class BlsOffloadClient(IBlsVerifier):
         RPC deadline is the class budget; hedge-class work that fails on
         its first endpoint retries ONCE on a different one before the
         error propagates (to the degradation chain, when configured)."""
-        frame = encode_sets(list(sets))
         n_sets = len(sets)
         priority = (
             PriorityClass(opts.priority)
             if opts is not None and opts.priority is not None
             else PriorityClass.API
+        )
+        frame = encode_sets(list(sets))
+        # tenant-stamped variant for capable endpoints: the trailer is
+        # a pure suffix, so the set bytes are serialized once (a hedge
+        # pair may legitimately send different framings; each attempt
+        # digest-checks against the exact bytes it sent)
+        frame_tenant = (
+            frame + encode_tenant_trailer(self.tenant, priority)
+            if self.tenant is not None
+            else None
         )
         deadline = self._deadline_for(priority)
         # trace context rides the call's metadata so server-side device
@@ -567,7 +650,13 @@ class BlsOffloadClient(IBlsVerifier):
         last_err: OffloadError | None = None
         loop = asyncio.get_event_loop()
         t_start = time.monotonic()
-        for attempt in range(max_attempts):
+        attempt = 0
+        # error attempts are bounded by max_attempts; a server-side
+        # admission SHED does NOT consume one — the endpoint explicitly
+        # told us to go elsewhere, so EVERY class may try a sibling
+        # (bounded by the untried-endpoint pool via `exclude` and by
+        # the class deadline, not by the hedge budget)
+        while attempt < max_attempts:
             # the class budget covers ALL attempts — a slow-but-alive
             # first endpoint must not double the stated slot-deadline
             # bound. The first attempt gets an equal share; a later one
@@ -576,31 +665,54 @@ class BlsOffloadClient(IBlsVerifier):
             remaining = deadline - (time.monotonic() - t_start)
             if remaining <= 0:
                 break
-            attempt_deadline = min(deadline / max_attempts, remaining) if attempt == 0 else remaining
+            attempt_deadline = min(deadline / max_attempts, remaining) if not tried else remaining
             picked = self._pick_endpoint(priority, exclude=tried)
             if picked is None:
                 break
             ep, token = picked
-            tried = tried + (ep,)
             if attempt > 0:
+                # a genuine hedge: a prior attempt FAILED and this class
+                # earned a retry. A shed-driven sibling attempt is not a
+                # hedge (it is logged in the shed handler) — counting it
+                # here would make shed storms read as hedge storms
                 self._note_hedge(tried[0], ep, priority, trace_parent)
+            tried = tried + (ep,)
             m = self._metrics
             if m is not None:
                 m.routed.labels(ep.target).inc()
             with self._lock:
                 self._outstanding += 1
                 ep.outstanding += 1
+            # tenant-stamped frame only toward capable endpoints: a
+            # legacy server keeps seeing the bit-exact legacy frame
+            use_frame = (
+                frame_tenant
+                # lint: allow(lock-discipline) — one-way sticky capability bit: a stale False sends one more legacy frame, which every server parses
+                if frame_tenant is not None and ep.tenant_capable
+                else frame
+            )
             try:
                 verdict = await loop.run_in_executor(
                     None,
                     self._call_endpoint,
-                    ep, token, frame, n_sets, priority, attempt_deadline, trace_hdr, trace_parent,
+                    ep, token, use_frame, n_sets, priority, attempt_deadline, trace_hdr, trace_parent,
                 )
                 if attempt > 0 and m is not None:
                     m.hedge_wins.labels(priority.label).inc()
                 return verdict
+            except OffloadShed as e:
+                # the server refused admission (tenant quota/overload):
+                # fail over without charging the endpoint — it is alive
+                last_err = e
+                self.log.info(
+                    "offload shed failover",
+                    {"from": ep.target, "class": priority.label, "reason": str(e)[:80]},
+                )
+                if m is not None:
+                    m.shed.labels("server_shed").inc()
             except OffloadError as e:
                 last_err = e
+                attempt += 1
                 if m is not None:
                     m.failovers.labels(ep.target).inc()
             finally:
@@ -680,6 +792,14 @@ class BlsOffloadClient(IBlsVerifier):
             with self._lock:
                 ep.healthy = False  # probe loop takes over reconnection
             raise OffloadError(f"offload transport: {e.code()}") from e
+        except OffloadShed as e:
+            # admission shed: the transport and server both answered —
+            # a half-open trial PASSED; only the admission said no.
+            # Charging the breaker here would blacklist a merely-busy
+            # endpoint exactly when siblings need its eventual headroom
+            err = f"shed: {e}"[:120]
+            ep.breaker.record_success(token)
+            raise
         except OffloadError as e:
             err = str(e)[:120]
             # a server answering with error/corrupt frames is sick even

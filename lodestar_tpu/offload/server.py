@@ -1,4 +1,4 @@
-"""Offload service host: the process that owns the accelerator.
+"""Offload service host: the process that owns the accelerator fleet.
 
 Exposes the verify backend over gRPC generic handlers (opaque-bytes
 methods — no proto codegen needed in this environment):
@@ -10,7 +10,18 @@ Status grades the old binary can-accept byte into an occupancy frame
 (EWMA busy-ns/wall-ns around device launches, in-flight depth, and an
 ACCEPT/SHED_BULK/REJECT admission state) so a multi-endpoint client can
 prefer the least-occupied host and keep bulk work off a shedding one.
-Byte 0 keeps the legacy meaning — old clients read it unchanged.
+Byte 0 keeps the legacy meaning — old clients read it unchanged. A
+mesh-backed host appends the per-chip table (occupancy + wedged flag
+per lane) so client routing sees FLEET headroom: a wedged/quarantined
+chip drops out of the advertised capacity within one probe interval.
+
+Multi-tenant front-end (`offload/tenancy.py`): verify frames may carry
+a tenant trailer (identity + launch class). Per-tenant admission quotas
+layer on the graded admission — a tenant over its depth quota gets the
+shed frame instead of service — and admitted work is granted backend
+slots in stride-fair cross-tenant order, so one greedy beacon node
+cannot starve the rest. Legacy clients (no trailer) account to the
+`default` tenant and parse every reply they always did.
 
 Run standalone (`python -m lodestar_tpu.offload.server`) next to the
 TPU, with beacon nodes connecting via `client.BlsOffloadClient` over
@@ -26,9 +37,21 @@ import grpc
 
 from lodestar_tpu import tracing
 from lodestar_tpu.logger import get_logger
-from lodestar_tpu.scheduler import AdmissionController, OccupancyTracker
+from lodestar_tpu.scheduler import (
+    AdmissionController,
+    AdmissionState,
+    OccupancyTracker,
+    PriorityClass,
+)
 
-from . import decode_sets, encode_status, encode_verdict
+from . import (
+    DEFAULT_TENANT,
+    decode_sets_ex,
+    encode_shed,
+    encode_status,
+    encode_verdict,
+)
+from .tenancy import TenantScheduler
 
 __all__ = ["BlsOffloadServer", "SERVICE_NAME", "VERIFY_METHOD", "STATUS_METHOD"]
 
@@ -41,6 +64,41 @@ def _identity(b: bytes) -> bytes:
     return b
 
 
+class _Replied(Exception):
+    """Internal _verify control flow: the reply (`out`) is already
+    built — skip the verify leg but still run the finally + trailing-
+    metadata blocks every reply path shares."""
+
+
+def fleet_occupancy_permille(chips) -> int:
+    """THE fleet-occupancy aggregate: mean over healthy (non-wedged)
+    chips, 1000 (pinned) when none is healthy. Shared by the Status
+    frame and the admission grader so the two can never diverge."""
+    healthy = [int(occ) for occ, wedged in chips if not wedged]
+    if not healthy:
+        return 1000
+    return max(0, min(1000, int(round(sum(healthy) / len(healthy)))))
+
+
+class _FleetOccupancyView:
+    """Admission-grading occupancy for a mesh-backed host: mean busy
+    fraction over HEALTHY chips (matching the Status frame's fleet
+    field). The server-level tracker measures "any RPC in flight",
+    which saturates toward 1.0 under modest multi-chip load and would
+    advertise REJECT while chips idle. Falls back to the server-level
+    tracker if the chip table errors."""
+
+    def __init__(self, chip_status_fn, fallback: OccupancyTracker) -> None:
+        self._fn = chip_status_fn
+        self._fallback = fallback
+
+    def occupancy(self) -> float:
+        try:
+            return fleet_occupancy_permille(self._fn()) / 1000.0
+        except Exception:
+            return self._fallback.occupancy()
+
+
 class BlsOffloadServer:
     """gRPC host around a verify backend.
 
@@ -49,7 +107,15 @@ class BlsOffloadServer:
     MAX_JOBS semantics when the backend is a BlsDeviceVerifierPool);
     on top of it the server tracks per-launch occupancy and grades
     admission — injectable `admission` (anything with .state()) lets
-    tests and smarter hosts replace the policy."""
+    tests and smarter hosts replace the policy.
+
+    `tenancy` (a TenantScheduler, or None to build a default one from
+    the tenant_* kwargs) owns per-tenant quotas + stride-fair service.
+    `chip_status_fn` () -> [(occupancy_permille, wedged)] feeds the
+    Status frame's mesh trailer; default: one pseudo-chip from the
+    server-level tracker (single-die hosts advertise exactly what they
+    are). Hosts serving a `BlsDeviceVerifierPool` pass the pool mesh's
+    `chip_table`."""
 
     def __init__(
         self,
@@ -63,6 +129,15 @@ class BlsOffloadServer:
         admission=None,
         shed_bulk_at: float = 0.75,
         reject_at: float = 0.95,
+        tenancy: TenantScheduler | None = None,
+        tenant_weights: dict[str, int] | None = None,
+        tenant_default_weight: int | None = None,
+        tenant_slots: int | None = None,
+        tenant_shed_depth: int | None = None,
+        tenant_reject_depth: int | None = None,
+        tenant_metrics=None,
+        chip_status_fn=None,
+        slot_wait_margin_s: float = 0.5,
     ) -> None:
         self.backend = backend
         self._can_accept_work = can_accept_work or (lambda: True)
@@ -70,7 +145,11 @@ class BlsOffloadServer:
         self._pending = 0  # guarded by: _pending_lock
         self._pending_lock = threading.Lock()
         self.admission = admission or AdmissionController(
-            self.occupancy,
+            # a mesh-backed host grades FLEET occupancy, not the
+            # single overlapped RPC tracker
+            _FleetOccupancyView(chip_status_fn, self.occupancy)
+            if chip_status_fn is not None
+            else self.occupancy,
             shed_bulk_at=shed_bulk_at,
             reject_at=reject_at,
             depth_fn=self._depth,
@@ -83,6 +162,27 @@ class BlsOffloadServer:
             reject_depth=1 << 30,
             can_accept=self._can_accept_work,
         )
+        tenancy_kwargs = {
+            # service slots default to the worker count: the scheduler
+            # then never blocks beyond what gRPC already bounds, so a
+            # single-tenant deployment behaves exactly like the
+            # pre-tenancy server
+            "slots": max_workers if tenant_slots is None else tenant_slots,
+            "weights": tenant_weights,
+            "metrics": tenant_metrics,
+        }
+        if tenant_default_weight is not None:
+            tenancy_kwargs["default_weight"] = tenant_default_weight
+        if tenant_shed_depth is not None:
+            tenancy_kwargs["shed_depth"] = tenant_shed_depth
+        if tenant_reject_depth is not None:
+            tenancy_kwargs["reject_depth"] = tenant_reject_depth
+        self.tenancy = tenancy or TenantScheduler(**tenancy_kwargs)
+        self._tenant_metrics = tenant_metrics
+        self._chip_status_fn = chip_status_fn
+        # reply-wire + expected-backend-launch reserve subtracted from
+        # the caller's RPC deadline when waiting for a service slot
+        self.slot_wait_margin_s = slot_wait_margin_s
         self.log = get_logger(name="lodestar.offload")
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         handlers = {
@@ -105,6 +205,17 @@ class BlsOffloadServer:
         with self._pending_lock:
             return self._pending
 
+    def _chip_table(self) -> list[tuple[int, bool]]:
+        """Per-chip (occupancy_permille, wedged) for the Status mesh
+        trailer; errors degrade to the single-die view rather than
+        failing the probe."""
+        if self._chip_status_fn is not None:
+            try:
+                return [(int(occ), bool(w)) for occ, w in self._chip_status_fn()]
+            except Exception:
+                pass
+        return [(self.occupancy.occupancy_permille(), False)]
+
     # -- handlers --------------------------------------------------------------
 
     def _verify(self, request: bytes, context) -> bytes:
@@ -121,19 +232,75 @@ class BlsOffloadServer:
         rec = tracing.remote_recorder(hdr)
         with self._pending_lock:
             self._pending += 1
+        tenant = DEFAULT_TENANT
+        granted = False
         try:
             with rec.span("offload_decode"):
-                sets = decode_sets(request)
+                sets, trailer = decode_sets_ex(request)
+            priority = PriorityClass.API
+            if trailer is not None:
+                tenant = trailer.tenant
+                priority = trailer.priority
+            # per-tenant quota grading, then the stride-fair slot wait —
+            # both sheds answer with the shed frame (alive, refusing),
+            # never an error frame (sick)
+            if not self.tenancy.admits(tenant, priority):
+                state = self.tenancy.admission_for(tenant)
+                self.tenancy.count_shed(tenant, priority, "quota")
+                self.log.info(
+                    "offload admission shed",
+                    {"tenant": tenant, "class": priority.label, "state": state.label},
+                )
+                # NOT an early return: shed replies fall through to the
+                # trailing-metadata block too — a shed storm is exactly
+                # when the operator needs the server-side trace legs
+                out = encode_shed(
+                    state, f"tenant quota ({state.label})", request=request
+                )
+                raise _Replied()
+            # the slot wait must resolve INSIDE the caller's RPC
+            # deadline: a shed frame the client never receives becomes
+            # DEADLINE_EXCEEDED on its side — a transport failure that
+            # charges the endpoint's breaker as sick, exactly what the
+            # shed frame exists to prevent. The margin must also cover
+            # the BACKEND launch after a grant — a grant at deadline
+            # minus epsilon converts the shed into the same
+            # DEADLINE_EXCEEDED mid-verify. slot_wait_margin_s should
+            # therefore sit above the host's typical launch time; no
+            # deadline metadata = scheduler cap.
+            slot_wait = None
+            try:
+                remaining = context.time_remaining()
+                if remaining is not None:
+                    slot_wait = max(0.0, remaining - self.slot_wait_margin_s)
+            except Exception:
+                pass
+            if not self.tenancy.acquire(tenant, priority, timeout_s=slot_wait):
+                self.tenancy.count_shed(tenant, priority, "slot_timeout")
+                out = encode_shed(
+                    AdmissionState.REJECT,
+                    "service slot wait timed out",
+                    request=request,
+                )
+                raise _Replied()
+            granted = True
             with rec.span("offload_device_verify", sets=len(sets)):
                 with self.occupancy.launch():
                     ok = bool(self.backend(sets))
+            m = self._tenant_metrics
+            if m is not None:
+                m.served_sets.labels(tenant).inc(len(sets))
             # digest-checked verdict: binds this reply to this request
             # frame so corruption/splicing fails closed at the client
             out = encode_verdict(ok, request=request)
+        except _Replied:
+            pass  # `out` already holds the shed frame
         except Exception as e:  # error frame, not a transport abort
-            self.log.warn("verify job failed", {"error": str(e)})
+            self.log.warn("verify job failed", {"error": str(e), "tenant": tenant})
             out = encode_verdict(None, error=f"{type(e).__name__}: {e}")
         finally:
+            if granted:
+                self.tenancy.release(tenant)
             with self._pending_lock:
                 self._pending -= 1
         payload = rec.serialize()
@@ -145,10 +312,16 @@ class BlsOffloadServer:
         return out
 
     def _status(self, request: bytes, context) -> bytes:
+        chips = self._chip_table()
+        # fleet occupancy (healthy-chip mean, same helper the admission
+        # grader uses): legacy v1-prefix readers also rank this host by
+        # its headroom, not one die
         return encode_status(
-            occupancy_permille=self.occupancy.occupancy_permille(),
+            occupancy_permille=fleet_occupancy_permille(chips),
             queue_depth=self._depth(),
             admission=self.admission.state(),
+            chips=chips,
+            tenant_capable=True,
         )
 
     # -- lifecycle -------------------------------------------------------------
@@ -158,19 +331,120 @@ class BlsOffloadServer:
         self.log.info("offload service up", {"port": self.port})
 
     def stop(self, grace: float = 0.5) -> None:
+        self.tenancy.close()
         self._server.stop(grace)
 
 
 def main() -> int:
-    """Standalone entry: host the repo's own verifier."""
+    """Standalone entry: host the repo's own verifier (the mesh-backed
+    device pool when devices are visible, the CPU oracle otherwise)."""
     import argparse
+    import json
 
-    from lodestar_tpu.crypto.bls.api import verify_signature_sets
+    from .tenancy import (
+        DEFAULT_TENANT_REJECT_DEPTH,
+        DEFAULT_TENANT_SHED_DEPTH,
+        parse_tenant_weights,
+    )
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=50051)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve lodestar_offload_tenant_* + a /metrics scrape here (0 = off)",
+    )
+    ap.add_argument(
+        "--bls-mesh", choices=["auto", "on", "off"], default="auto",
+        help="serve the device mesh: per-chip launch lanes + data-parallel "
+        "bulk sharding (auto = when the Pallas backend is live and more "
+        "than one device is visible); off = CPU oracle backend",
+    )
+    ap.add_argument(
+        "--tenant-weight", action="append", default=[], metavar="NAME=WEIGHT",
+        help="stride-fair service share for a tenant (repeatable); unlisted "
+        "tenants get --tenant-default-weight",
+    )
+    ap.add_argument("--tenant-default-weight", type=int, default=1)
+    ap.add_argument(
+        "--tenant-slots", type=int, default=None,
+        help="concurrent backend service slots the stride scheduler grants "
+        "(default: --workers, which never queues — set BELOW --workers to "
+        "make cross-tenant fairness and quota sheds actually arbitrate; "
+        "e.g. the chip count of the served mesh)",
+    )
+    ap.add_argument(
+        "--tenant-shed-depth", type=int, default=DEFAULT_TENANT_SHED_DEPTH,
+        help="per-tenant pending+running depth at which bulk classes shed",
+    )
+    ap.add_argument(
+        "--tenant-reject-depth", type=int, default=DEFAULT_TENANT_REJECT_DEPTH,
+        help="per-tenant pending+running depth at which everything sheds",
+    )
     args = ap.parse_args()
-    server = BlsOffloadServer(verify_signature_sets, port=args.port)
+
+    from lodestar_tpu.crypto.bls.api import verify_signature_sets
+
+    chip_status_fn = None
+    backend = verify_signature_sets
+    if args.bls_mesh != "off":
+        # serve the mesh synchronously: mesh_launch keeps the per-chip
+        # wedge accounting + cross-lane error retry (a sick chip trips
+        # ITS breaker, drops out of the advertised chip table, and
+        # self-offers after the reset delay); the server's slot
+        # scheduler bounds concurrency per tenant above it
+        from lodestar_tpu.chain.bls.mesh import build_device_mesh, mesh_launch
+
+        mesh = build_device_mesh(args.bls_mesh)
+        if args.bls_mesh == "auto" and len(mesh) == 1:
+            # auto found no live multi-chip mesh: keep the historical
+            # CPU-oracle backend — a single jax-on-CPU lane would
+            # silently trade it for minutes-long first-use XLA compiles
+            pass
+        else:
+            chip_status_fn = mesh.chip_table
+
+            def backend(sets, _mesh=mesh):
+                ok, _lane = mesh_launch(_mesh, sets)
+                return ok
+
+    metrics_server = None
+    tenant_metrics = None
+    if args.metrics_port:
+        from lodestar_tpu.metrics import (
+            MetricsServer,
+            RegistryMetricCreator,
+            create_tenant_metrics,
+        )
+
+        creator = RegistryMetricCreator()
+        tenant_metrics = create_tenant_metrics(creator)
+        metrics_server = MetricsServer(creator, port=args.metrics_port)
+        metrics_server.start()
+
+    server = BlsOffloadServer(
+        backend,
+        port=args.port,
+        max_workers=args.workers,
+        tenant_weights=parse_tenant_weights(args.tenant_weight),
+        tenant_default_weight=args.tenant_default_weight,
+        # default: workers (never queues — single-tenant hosts behave
+        # exactly like the pre-tenancy server); fairness enforcement
+        # needs slots < concurrent demand, e.g. the mesh's chip count
+        tenant_slots=args.workers if args.tenant_slots is None else args.tenant_slots,
+        tenant_shed_depth=args.tenant_shed_depth,
+        tenant_reject_depth=args.tenant_reject_depth,
+        tenant_metrics=tenant_metrics,
+        chip_status_fn=chip_status_fn,
+    )
+    # surface the effective tenancy config once, for operators' logs
+    server.log.info(
+        "offload tenancy",
+        {
+            "weights": json.dumps(parse_tenant_weights(args.tenant_weight)),
+            "default_weight": args.tenant_default_weight,
+        },
+    )
     server.start()
     import signal
     import threading
@@ -180,6 +454,8 @@ def main() -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     server.stop()
+    if metrics_server is not None:
+        metrics_server.stop()
     return 0
 
 

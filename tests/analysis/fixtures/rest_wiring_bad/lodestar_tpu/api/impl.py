@@ -1,0 +1,12 @@
+"""rest-route-wiring bad fixture impl side."""
+
+
+class BeaconApiImpl:
+    def get_genesis(self):
+        return {}
+
+    def get_unreachable(self):  # 4: public, no route reaches it
+        return {}
+
+    def _private_helper(self):  # NOT a finding: private
+        return {}

@@ -1,0 +1,21 @@
+"""rest-route-wiring bad fixture: every gap class once."""
+
+ROUTES = [
+    ("GET", r"/eth/v1/beacon/genesis", "r_genesis"),
+    ("GET", r"/eth/v1/beacon/ghost", "r_ghost"),  # 1: handler missing
+]
+
+
+class _Router:
+    def __init__(self, api):
+        self.api = api
+
+    def r_genesis(self, **kw):
+        return self.api.get_genesis()
+
+    def r_orphan(self, **kw):  # 2: handler with no route
+        return self.api.get_renamed_away()  # 3: impl method missing
+
+    # NOT a finding: helpers without the r_ prefix are router plumbing
+    def dispatch(self, method, path):
+        return None

@@ -1,0 +1,12 @@
+"""rest-route-wiring ok fixture impl side."""
+
+
+class BeaconApiImpl:
+    def get_genesis(self):
+        return {}
+
+    def get_health(self):
+        return 200
+
+    def _state_at(self, state_id):
+        return None

@@ -1,0 +1,17 @@
+"""rest-route-wiring ok fixture: fully two-way wired."""
+
+ROUTES = [
+    ("GET", r"/eth/v1/beacon/genesis", "r_genesis"),
+    ("GET", r"/eth/v1/node/health", "r_health"),
+]
+
+
+class _Router:
+    def __init__(self, api):
+        self.api = api
+
+    def r_genesis(self, **kw):
+        return self.api.get_genesis()
+
+    def r_health(self, **kw):
+        return self.api.get_health()

@@ -202,3 +202,66 @@ def test_pragma_suppressing_project_rule_finding_not_stale_under_path_spelling(
         pragma_hygiene=True,
     )
     assert findings == [], [f.format() for f in findings]
+
+
+# -- rest-route-wiring (project-scoped) ---------------------------------------
+
+
+def rest_wiring_findings(root: str):
+    return analyze(
+        [],
+        rules=[RULES_BY_NAME["rest-route-wiring"]],
+        repo_root=FIXTURES / root,
+        pragma_hygiene=False,
+    )
+
+
+def test_rest_wiring_flags_every_gap_class():
+    msgs = [f.message for f in rest_wiring_findings("rest_wiring_bad")]
+    joined = " | ".join(msgs)
+    # route -> handler: ROUTES names a method the router lacks
+    assert "ROUTES names handler 'r_ghost'" in joined
+    # handler -> route: defined r_* with no dispatching entry
+    assert "_Router.r_orphan is defined but no ROUTES entry" in joined
+    # server -> impl: handler reaches a method the impl renamed away
+    assert "self.api.get_renamed_away" in joined
+    # impl -> server: public impl surface no route reaches
+    assert "BeaconApiImpl.get_unreachable is public" in joined
+    # private impl helpers and non-r_ router plumbing stay quiet
+    assert not any("_private_helper" in m or "'dispatch'" in m for m in msgs)
+    assert len(msgs) == 4, joined
+
+
+def test_rest_wiring_clean_tree():
+    assert rest_wiring_findings("rest_wiring_ok") == []
+
+
+def test_rest_wiring_allowlist_silences_and_goes_stale(monkeypatch):
+    from tools.analysis.rules import rest_wiring as rw
+
+    # an allowlisted unreachable impl method is silenced...
+    monkeypatch.setattr(
+        rw,
+        "UNROUTED_IMPL_ALLOWLIST",
+        {"get_unreachable": "fixture: consumed by an internal client"},
+    )
+    msgs = [f.message for f in rest_wiring_findings("rest_wiring_bad")]
+    assert not any("get_unreachable is public" in m for m in msgs)
+    assert len(msgs) == 3
+    # ...and an entry naming no impl method is flagged stale
+    monkeypatch.setattr(
+        rw, "UNROUTED_IMPL_ALLOWLIST", {"never_existed": "stale entry"}
+    )
+    msgs = [f.message for f in rest_wiring_findings("rest_wiring_ok")]
+    assert len(msgs) == 1 and "names no public" in msgs[0]
+
+
+def test_rest_wiring_real_tree_is_clean():
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    findings = analyze(
+        [],
+        rules=[RULES_BY_NAME["rest-route-wiring"]],
+        repo_root=repo,
+        pragma_hygiene=False,
+    )
+    assert findings == [], [f.format() for f in findings]

@@ -1,0 +1,314 @@
+"""Status mesh trailer: per-chip occupancy aggregation, legacy/mixed-
+version compatibility, quarantined-chip capacity drop — and the
+continuous trust-weighted occupancy routing (the carried
+`_occupancy_key` item)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.offload import (
+    ChipStatus,
+    OffloadError,
+    decode_status,
+    encode_status,
+)
+from lodestar_tpu.offload.audit import AuditSampler, OffloadAuditor
+from lodestar_tpu.offload.client import (
+    TRUST_PENALTY_SPAN,
+    BlsOffloadClient,
+    _occupancy_key,
+)
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.scheduler import AdmissionState, PriorityClass
+from lodestar_tpu.testing.faults import FaultInjector
+
+
+def _sets(n: int = 2, tag: int = 0) -> list[SignatureSet]:
+    return [
+        SignatureSet(
+            pubkey=bytes([1, tag, i % 256]) + bytes(45),
+            message=bytes([2, tag, i % 256]) * 8 + bytes(8),
+            signature=bytes([3, tag, i % 256]) + bytes(93),
+        )
+        for i in range(n)
+    ]
+
+
+_GOSSIP = VerifySignatureOpts(priority=PriorityClass.GOSSIP_ATTESTATION)
+
+
+# -- frame format --------------------------------------------------------------
+
+
+def test_mesh_trailer_roundtrip_and_capacity():
+    frame = encode_status(
+        occupancy_permille=400,
+        queue_depth=3,
+        admission=AdmissionState.ACCEPT,
+        chips=[(100, False), (700, False), (900, True)],
+        tenant_capable=True,
+    )
+    st = decode_status(frame)
+    assert st.extended and st.tenant_capable
+    assert st.occupancy_permille == 400 and st.queue_depth == 3
+    assert st.chips == (
+        ChipStatus(100, False),
+        ChipStatus(700, False),
+        ChipStatus(900, True),
+    )
+    # the wedged chip drops out of advertised capacity
+    assert st.capacity == 2
+
+
+def test_mesh_trailer_absent_and_legacy_frames_still_parse():
+    # v1 frame without trailer: pre-mesh servers
+    v1 = encode_status(occupancy_permille=250, queue_depth=1, admission=0)
+    st = decode_status(v1)
+    assert st.extended and st.chips == () and not st.tenant_capable
+    assert st.capacity == 1
+    # legacy one-byte peers
+    legacy = decode_status(b"\x01")
+    assert legacy.can_accept and not legacy.extended
+    assert legacy.capacity == 1
+    # a malformed/future-version trailer degrades to the v1 view
+    # instead of failing the probe
+    mangled = v1 + b"\xc4\x63\x00\x02garbage"
+    st = decode_status(mangled)
+    assert st.extended and st.chips == ()
+    truncated = encode_status(
+        occupancy_permille=1, queue_depth=1, admission=0, chips=[(1, False)]
+    )[:-1]
+    st = decode_status(truncated)
+    assert st.extended and st.chips == ()
+
+
+def test_server_status_aggregates_healthy_chips_only():
+    server = BlsOffloadServer(
+        lambda s: True,
+        port=0,
+        chip_status_fn=lambda: [(100, False), (300, False), (1000, True)],
+    )
+    st = decode_status(server._status(b"", None))
+    # fleet occupancy = mean over HEALTHY chips (200), not the wedged die
+    assert st.occupancy_permille == 200
+    assert st.capacity == 2
+    assert sum(1 for c in st.chips if c.wedged) == 1
+    assert st.tenant_capable
+
+
+# -- routing -------------------------------------------------------------------
+
+
+def _mk_two_endpoint_client(**kw):
+    server_a = BlsOffloadServer(lambda s: True, port=0)
+    server_b = BlsOffloadServer(lambda s: True, port=0)
+    server_a.start()
+    server_b.start()
+    A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+    inj = FaultInjector()
+    client = BlsOffloadClient(
+        [A, B],
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+        **kw,
+    )
+    return server_a, server_b, A, B, inj, client
+
+
+def _set_ep(client, target, **fields):
+    with client._lock:
+        for ep in client._endpoints:
+            if ep.target == target:
+                for k, v in fields.items():
+                    setattr(ep, k, v)
+
+
+def _wait_probed(client, timeout_s: float = 5.0) -> None:
+    """Let the STARTUP probe land before injecting endpoint state —
+    otherwise it overwrites the injected occupancies (the interval is
+    pinned to 3600s, so no further refresh happens)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(s["extended"] for s in client.endpoint_states()):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"startup probe never landed: {client.endpoint_states()}")
+
+
+def test_mixed_version_routing_stays_least_occupied():
+    """A mesh-capable endpoint and a legacy endpoint rank by the same
+    occupancy scale; the chip capacity only normalizes in-flight depth."""
+    server_a, server_b, A, B, inj, client = _mk_two_endpoint_client()
+    try:
+        _wait_probed(client)
+        # A: mesh server, fleet occ 300 over 8 chips; B: legacy, occ 200
+        _set_ep(client, A, occupancy_permille=300, capacity=8)
+        _set_ep(client, B, occupancy_permille=200, capacity=1)
+
+        async def go(n):
+            for i in range(n):
+                assert await client.verify_signature_sets(_sets(tag=i), _GOSSIP)
+
+        asyncio.run(go(3))
+        assert inj.calls_to(B, "verify") == 3  # least-occupied wins
+        # flip: the mesh host now has the headroom
+        _set_ep(client, A, occupancy_permille=100)
+        asyncio.run(go(2))
+        assert inj.calls_to(A, "verify") == 2
+    finally:
+        asyncio.run(client.close())
+        server_a.stop()
+        server_b.stop()
+
+
+def test_capacity_normalizes_outstanding_depth():
+    # equal occupancy: 8 outstanding on an 8-chip host ranks like 1 on
+    # a single die
+    from types import SimpleNamespace
+
+    mesh_ep = SimpleNamespace(occupancy_permille=300, outstanding=8, capacity=8)
+    single_ep = SimpleNamespace(occupancy_permille=300, outstanding=2, capacity=1)
+    assert _occupancy_key(mesh_ep) < _occupancy_key(single_ep)
+
+
+def test_quarantined_chip_drops_out_of_advertised_capacity():
+    """End-to-end: the server's chip table marks a wedged lane; the
+    client's probe-refreshed endpoint state loses that capacity within
+    one probe."""
+    chips = [[(100, False), (150, False)]]
+    server = BlsOffloadServer(lambda s: True, port=0, chip_status_fn=lambda: chips[0])
+    server.start()
+    client = BlsOffloadClient(f"127.0.0.1:{server.port}", probe_interval_s=3600.0)
+    try:
+        assert client._probe_one(client._endpoints[0])
+        st = client.endpoint_states()[0]
+        assert st["capacity"] == 2 and st["chips_wedged"] == 0
+        chips[0] = [(100, False), (1000, True)]  # lane wedged/quarantined
+        assert client._probe_one(client._endpoints[0])
+        st = client.endpoint_states()[0]
+        assert st["capacity"] == 1 and st["chips_wedged"] == 1
+        # fleet occupancy now reflects the surviving chip only
+        assert st["occupancy_permille"] == 100
+    finally:
+        asyncio.run(client.close())
+        server.stop()
+
+
+# -- continuous trust weighting ------------------------------------------------
+
+
+def test_trust_penalty_is_continuous_and_preserves_threshold_demotion():
+    from types import SimpleNamespace
+
+    ep = SimpleNamespace(occupancy_permille=100, outstanding=0, capacity=1)
+    k_full = _occupancy_key(ep, 1.0)[0]
+    k_dip = _occupancy_key(ep, 0.9)[0]
+    k_half = _occupancy_key(ep, 0.5)[0]
+    k_zero = _occupancy_key(ep, 0.0)[0]
+    assert k_full < k_dip < k_half < k_zero
+    # at the route threshold the penalty covers the FULL occupancy
+    # scale: a sub-threshold endpoint loses to any trusted one
+    assert k_half - k_full == 1000
+    assert k_zero - k_full == TRUST_PENALTY_SPAN
+
+
+def test_load_shifts_gradually_as_contradictions_accumulate():
+    """Regression for the carried item: occupancy-preferred endpoint A
+    keeps serving through the first contradictions and is only demoted
+    once the accumulated trust penalty exceeds its occupancy advantage
+    — a cliff at one contradiction (or none at many) fails."""
+    server_a, server_b, A, B, inj, client = None, None, None, None, None, None
+    server_a = BlsOffloadServer(lambda s: True, port=0)
+    server_b = BlsOffloadServer(lambda s: True, port=0)
+    server_a.start()
+    server_b.start()
+    A, B = f"127.0.0.1:{server_a.port}", f"127.0.0.1:{server_b.port}"
+    inj = FaultInjector()
+    aud = OffloadAuditor(sampler=AuditSampler(0.0, seed=0), start=False)
+    client = BlsOffloadClient(
+        [A, B],
+        probe_interval_s=3600.0,
+        transport_wrapper=inj.wrap_transport,
+        auditor=aud,
+    )
+    try:
+        _wait_probed(client)
+        # A is much less occupied (100 vs 900 permille): strongly preferred
+        _set_ep(client, A, occupancy_permille=100, capacity=1)
+        _set_ep(client, B, occupancy_permille=1000, capacity=1)
+
+        async def one(tag):
+            assert await client.verify_signature_sets(_sets(tag=tag), _GOSSIP)
+
+        ts = aud.trust_for(A)
+        served_a = []
+        for round_ in range(4):
+            asyncio.run(one(round_))
+            served_a.append(inj.calls_to(A, "verify"))
+            ts.record(False)  # one more audit contradiction
+        # trust 1.0 -> .75 -> .5625 -> .42: penalties 0, 500, 875, 1156
+        # vs B's occupancy edge of 900. A keeps the load through the
+        # first contradiction (penalty 500 < 900)...
+        assert served_a[0] == 1 and served_a[1] == 2
+        # ...and the load lands on B once the penalty crosses the edge
+        asyncio.run(one(99))
+        assert inj.calls_to(B, "verify") >= 1
+        assert inj.calls_to(A, "verify") <= 3
+    finally:
+        asyncio.run(client.close())
+        aud.close()
+        server_a.stop()
+        server_b.stop()
+
+
+def test_trust_recovers_load_after_agreements():
+    """The fold is symmetric: agreements claw trust (and load) back —
+    the binary demotion could only flip, never recover gradually."""
+    from types import SimpleNamespace
+
+    ep = SimpleNamespace(occupancy_permille=100, outstanding=0, capacity=1)
+    aud = OffloadAuditor(sampler=AuditSampler(0.0, seed=0), start=False)
+    try:
+        ts = aud.trust_for("X")
+        for _ in range(2):
+            ts.record(False)
+        penalized = _occupancy_key(ep, aud.trust_value("X"))[0]
+        for _ in range(30):
+            ts.record(True)
+        recovered = _occupancy_key(ep, aud.trust_value("X"))[0]
+        assert recovered < penalized
+        assert recovered - _occupancy_key(ep, 1.0)[0] < 300
+    finally:
+        aud.close()
+
+
+def test_admission_grades_fleet_occupancy_not_rpc_tracker():
+    """Review regression: a mesh-backed host must grade admission from
+    the healthy-chip fleet view — the server-level tracker measures
+    "any RPC in flight" and would advertise REJECT while chips idle."""
+    server = BlsOffloadServer(
+        lambda s: True,
+        port=0,
+        chip_status_fn=lambda: [(100, False)] * 4,
+    )
+    # pin the RPC-level tracker busy: without the fleet view this
+    # EWMA climbs toward 1.0 and flips admission to REJECT
+    server.occupancy.begin()
+    try:
+        time.sleep(0.05)
+        assert server.admission.state() is AdmissionState.ACCEPT
+    finally:
+        server.occupancy.end()
+    # all chips wedged = pinned fleet -> REJECT regardless of tracker
+    wedged = BlsOffloadServer(
+        lambda s: True,
+        port=0,
+        chip_status_fn=lambda: [(100, True), (200, True)],
+    )
+    assert wedged.admission.state() is AdmissionState.REJECT

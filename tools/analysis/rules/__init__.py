@@ -6,6 +6,7 @@ from .blocking_under_lock import BlockingUnderLockRule
 from .fail_closed import FailClosedVerdictsRule
 from .lock_discipline import LockDisciplineRule
 from .monotonic import MonotonicDurationsRule
+from .rest_wiring import RestRouteWiringRule
 from .span_discipline import SpanDisciplineRule
 from .wiring import MetricsCliWiringRule
 
@@ -16,6 +17,7 @@ ALL_RULES = (
     SpanDisciplineRule(),
     MonotonicDurationsRule(),
     MetricsCliWiringRule(),
+    RestRouteWiringRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -1,0 +1,209 @@
+"""rest-route-wiring: REST route table ↔ router handlers ↔ API impl,
+both directions — the cross-file sibling of the cli.py ↔
+BeaconNodeOptions rule (same doctrine: a route that parses but reaches
+no handler, or an impl method no route can reach, silently does
+nothing exactly when a standard beacon client calls it).
+
+Project-scoped over two fixed locations:
+
+1. **ROUTES → _Router**: every handler name in the
+   ``lodestar_tpu/api/server.py`` ``ROUTES`` table must be a method of
+   ``_Router`` — a typo'd handler name 404s (AttributeError at
+   construction) only at runtime.
+2. **_Router → ROUTES**: every ``r_*`` method on ``_Router`` must be
+   named by some ROUTES entry — an unrouted handler is dead code that
+   LOOKS like an exposed endpoint.
+3. **server → impl**: every ``self.api.X`` access inside ``_Router``
+   must be an attribute ``BeaconApiImpl`` actually defines
+   (``lodestar_tpu/api/impl.py``) — the gap class where a handler
+   dispatches to a method that was renamed on the impl.
+4. **impl → server**: every public method of ``BeaconApiImpl`` must be
+   reached by some ``self.api.X`` access in the server, or carry an
+   entry in ``UNROUTED_IMPL_ALLOWLIST`` with a reason — an impl method
+   no route reaches is API surface that silently fell off the REST
+   server. Allowlist entries naming no impl method are flagged stale
+   (same doctrine as unused pragmas).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..core import Finding, Rule
+
+#: BeaconApiImpl public methods intentionally not behind a REST route;
+#: every entry carries the reason. (Currently empty — the tree is fully
+#: two-way wired; the dict exists so a future internal-consumer method
+#: documents itself instead of growing a pragma.)
+UNROUTED_IMPL_ALLOWLIST: dict[str, str] = {}
+
+ROUTER_CLASS = "_Router"
+IMPL_CLASS = "BeaconApiImpl"
+HANDLER_PREFIX = "r_"
+
+
+def _routes_entries(tree: ast.Module) -> list[tuple[str, int]]:
+    """(handler_name, line) per ROUTES tuple entry."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "ROUTES" for t in targets):
+            continue
+        value = node.value
+        if not isinstance(value, (ast.List, ast.Tuple)):
+            continue
+        for elt in value.elts:
+            if not isinstance(elt, (ast.Tuple, ast.List)) or len(elt.elts) < 3:
+                continue
+            handler = elt.elts[2]
+            if isinstance(handler, ast.Constant) and isinstance(handler.value, str):
+                out.append((handler.value, elt.lineno))
+    return out
+
+
+def _class_def(tree: ast.Module, name: str) -> ast.ClassDef | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _methods(cls: ast.ClassDef) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for fn in cls.body:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(fn.name, fn.lineno)
+    return out
+
+
+def _api_accesses(cls: ast.ClassDef) -> dict[str, int]:
+    """attr -> first line for every `self.api.attr` / `<x>.api.attr`
+    access inside the router class."""
+    out: dict[str, int] = {}
+    for node in ast.walk(cls):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "api"
+        ):
+            out.setdefault(node.attr, node.lineno)
+    return out
+
+
+def _allowlist_line(name: str) -> int:
+    for i, line in enumerate(Path(__file__).read_text(encoding="utf-8").splitlines(), 1):
+        if f'"{name}"' in line:
+            return i
+    return 1
+
+
+class RestRouteWiringRule(Rule):
+    name = "rest-route-wiring"
+    description = (
+        "REST route table ↔ router handlers ↔ BeaconApiImpl methods are "
+        "wired both ways (routes reach handlers, handlers reach real impl "
+        "methods, impl surface is routed or allowlisted)"
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        findings: list[Finding] = []
+        server_path = repo_root / "lodestar_tpu" / "api" / "server.py"
+        impl_path = repo_root / "lodestar_tpu" / "api" / "impl.py"
+        if not (server_path.is_file() and impl_path.is_file()):
+            return findings
+        server_tree = ast.parse(
+            server_path.read_text(encoding="utf-8"), filename=str(server_path)
+        )
+        impl_tree = ast.parse(
+            impl_path.read_text(encoding="utf-8"), filename=str(impl_path)
+        )
+        router = _class_def(server_tree, ROUTER_CLASS)
+        impl = _class_def(impl_tree, IMPL_CLASS)
+        if router is None or impl is None:
+            # the rule's anchors moved: that is itself a wiring break
+            missing = ROUTER_CLASS if router is None else IMPL_CLASS
+            where = server_path if router is None else impl_path
+            findings.append(
+                Finding(
+                    self.name, str(where), 1,
+                    f"class {missing} not found — the rest-route-wiring "
+                    "anchors moved; update the rule",
+                )
+            )
+            return findings
+
+        routes = _routes_entries(server_tree)
+        handlers = _methods(router)
+        handler_names = {n for n in handlers if n.startswith(HANDLER_PREFIX)}
+        routed = {name for name, _ in routes}
+
+        # 1. ROUTES -> _Router
+        for name, line in routes:
+            if name not in handlers:
+                findings.append(
+                    Finding(
+                        self.name, str(server_path), line,
+                        f"ROUTES names handler '{name}' but {ROUTER_CLASS} "
+                        "defines no such method — the route 404s at runtime",
+                    )
+                )
+        # 2. _Router -> ROUTES
+        for name in sorted(handler_names - routed):
+            findings.append(
+                Finding(
+                    self.name, str(server_path), handlers[name],
+                    f"{ROUTER_CLASS}.{name} is defined but no ROUTES entry "
+                    "dispatches to it — dead handler or missing route",
+                )
+            )
+
+        impl_methods = _methods(impl)
+        api_calls = _api_accesses(router)
+
+        # 3. server -> impl
+        for attr, line in sorted(api_calls.items()):
+            if attr not in impl_methods:
+                findings.append(
+                    Finding(
+                        self.name, str(server_path), line,
+                        f"router accesses self.api.{attr} but {IMPL_CLASS} "
+                        "defines no such method — the handler raises at "
+                        "dispatch",
+                    )
+                )
+        # 4. impl -> server
+        public = {
+            n: line
+            for n, line in impl_methods.items()
+            if not n.startswith("_")
+        }
+        for attr in sorted(set(public) - set(api_calls)):
+            if attr in UNROUTED_IMPL_ALLOWLIST:
+                continue
+            findings.append(
+                Finding(
+                    self.name, str(impl_path), public[attr],
+                    f"{IMPL_CLASS}.{attr} is public but no router handler "
+                    "reaches it — add a route or an "
+                    "UNROUTED_IMPL_ALLOWLIST entry with a reason",
+                )
+            )
+        # allowlist staleness
+        for name in sorted(UNROUTED_IMPL_ALLOWLIST):
+            if name not in public:
+                findings.append(
+                    Finding(
+                        self.name, __file__, _allowlist_line(name),
+                        f"UNROUTED_IMPL_ALLOWLIST entry '{name}' names no "
+                        f"public {IMPL_CLASS} method — remove the stale entry",
+                    )
+                )
+        return findings

@@ -408,6 +408,148 @@ def epoch_htr_replay():
     _line("epoch_htr_ms_cpu", cpu_ms, "ms", 1.0)
 
 
+def mesh_scaling():
+    """`mesh_sigs_per_sec_{n}dev` for n in 1/2/4/8 ∩ visible devices:
+    the same prepared batch (fresh blinding per launch, host prep
+    excluded — the scaling of the VERIFY pipeline is the question)
+    through the single-device program and the data-parallel sharded
+    program over growing sub-meshes. On the production host this is
+    the single-vs-mesh headline the PR 8 serving pool banks on; a
+    1-device container emits only the 1dev line."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from lodestar_tpu.models import batch_verify as bv
+
+    devices = jax.devices()
+    counts = [n for n in (1, 2, 4, 8) if n <= len(devices)]
+    n = 256 if QUICK else 1024
+    sets = bv.make_synthetic_sets(n, seed=43)
+    prev = bv.configure_device_prep(mode="off")
+    try:
+        inputs = bv.build_device_inputs(sets, size=n)
+        if inputs is None:
+            raise RuntimeError("mesh bench rejected valid sets")
+        pk, h, sig, bits, mask = inputs
+        iters = 3
+        for n_dev in counts:
+            if n_dev == 1:
+                run = lambda b: bv.device_batch_verify(pk, h, sig, b, mask)
+            else:
+                mesh = Mesh(np.asarray(devices[:n_dev]), ("data",))
+                run = lambda b, m=mesh: bv.device_batch_verify_sharded(
+                    m, pk, h, sig, b, mask
+                )
+            if not bool(np.asarray(run(bits))):  # warm the compile
+                raise RuntimeError(f"mesh bench rejected valid sets at {n_dev} devices")
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fresh = bv._bits_msb(bv._random_coeffs(n), bv.COEFF_BITS)
+                if not bool(np.asarray(run(fresh))):
+                    raise RuntimeError(
+                        f"mesh bench rejected valid sets at {n_dev} devices"
+                    )
+            dt = (time.perf_counter() - t0) / iters
+            _line(f"mesh_sigs_per_sec_{n_dev}dev", n / dt, "sigs/s",
+                  (n / dt) / REFERENCE_SIGS_PER_SEC_PER_CORE)
+    finally:
+        bv.configure_device_prep(mode=prev)
+
+
+def two_tenant_fairness_replay():
+    """Saturated two-tenant replay against the offload front-end:
+    tenants alice (weight 3) and bob (weight 1) over-admit bulk work
+    against one service slot; the line reports the worst deviation of
+    served shares from the configured 75/25 split, in percentage
+    points (acceptance envelope: 10). The backend is a fixed 2 ms stub
+    — service time is a parameter here; the MEASUREMENT is the stride
+    scheduler's cross-tenant fairness, which is what the serving host
+    runs regardless of die speed."""
+    import asyncio
+    import threading
+
+    from lodestar_tpu.offload.client import BlsOffloadClient
+    from lodestar_tpu.offload.server import BlsOffloadServer
+
+    def backend(sets):
+        time.sleep(0.002)
+        return True
+
+    server = BlsOffloadServer(
+        backend, port=0, max_workers=8,
+        tenant_weights={"alice": 3, "bob": 1}, tenant_slots=1,
+    )
+    server.start()
+    target = f"127.0.0.1:{server.port}"
+    from lodestar_tpu.models.batch_verify import make_synthetic_sets
+
+    job = make_synthetic_sets(4, seed=44)
+    clients = {
+        name: BlsOffloadClient(target, probe_interval_s=0.05, tenant=name)
+        for name in ("alice", "bob")
+    }
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(
+                s["tenant_capable"]
+                for c in clients.values()
+                for s in c.endpoint_states()
+            ):
+                break
+            time.sleep(0.02)
+
+        async def go():
+            stop = asyncio.Event()
+            from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
+            from lodestar_tpu.scheduler import PriorityClass
+
+            bulk = VerifySignatureOpts(priority=PriorityClass.BACKFILL)
+
+            async def pump(client):
+                while not stop.is_set():
+                    try:
+                        await client.verify_signature_sets(job, bulk)
+                    except Exception:
+                        await asyncio.sleep(0.001)
+
+            pumps = [
+                asyncio.ensure_future(pump(c))
+                for c in clients.values()
+                for _ in range(8)
+            ]
+            while not all(
+                server.tenancy.served.get(t, 0) > 0 for t in ("alice", "bob")
+            ):
+                await asyncio.sleep(0.01)
+            base = {t: server.tenancy.served.get(t, 0) for t in ("alice", "bob")}
+            target_grants = 150 if QUICK else 600
+            while True:
+                window = {
+                    t: server.tenancy.served.get(t, 0) - base[t]
+                    for t in ("alice", "bob")
+                }
+                if sum(window.values()) >= target_grants:
+                    break
+                await asyncio.sleep(0.02)
+            stop.set()
+            await asyncio.gather(*pumps, return_exceptions=True)
+            return window
+
+        window = asyncio.run(go())
+        total = sum(window.values())
+        err_pct = 100.0 * max(
+            abs(window["alice"] / total - 0.75), abs(window["bob"] / total - 0.25)
+        )
+        # vs_baseline: fraction of the 10-point acceptance envelope used
+        _line("two_tenant_fairness_share_error_pct", err_pct, "pct", err_pct / 10.0)
+    finally:
+        for c in clients.values():
+            asyncio.run(c.close())
+        server.stop()
+
+
 def main():
     host_prep_rate()
     device_prep_rate()
@@ -418,6 +560,8 @@ def main():
     config2_gossip_replay()
     config2_gossip_replay(device_prep=True)
     config3_sync_committee_aggregate()
+    mesh_scaling()
+    two_tenant_fairness_replay()
 
 
 if __name__ == "__main__":

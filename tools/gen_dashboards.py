@@ -293,6 +293,80 @@ def validator_monitor():
     )
 
 
+def mesh_serving_dashboard():
+    """Multi-chip serving (chain/bls/mesh.py + offload/tenancy.py):
+    per-device occupancy/launch/wedge state for the verifier mesh and
+    per-tenant served/shed/in-flight for the multi-tenant offload
+    front-end. The "is the fleet healthy and is every tenant getting
+    its share" dashboard."""
+    ps = [
+        panel(
+            "Per-chip occupancy (‰)",
+            [("lodestar_sched_lane_occupancy_permille", "{{device}}")],
+            pid=1,
+        ),
+        panel(
+            "Mesh lanes available (non-wedged)",
+            [("lodestar_sched_mesh_lanes_available", "lanes")],
+            x=12, pid=2,
+        ),
+        panel(
+            "Launch rate by chip and mode",
+            [
+                (
+                    "sum by (device, mode) (rate(lodestar_sched_lane_launches_total[5m]))",
+                    "{{device}} {{mode}}",
+                ),
+            ],
+            unit="ops", y=8, pid=3,
+        ),
+        panel(
+            "Per-chip wedge-breaker trips",
+            [
+                (
+                    "sum by (device) (increase(lodestar_sched_lane_wedge_trips_total[1h]))",
+                    "{{device}}",
+                ),
+            ],
+            x=12, y=8, pid=4,
+        ),
+        panel(
+            "Tenant served sets rate",
+            [
+                (
+                    "sum by (tenant) (rate(lodestar_offload_tenant_served_sets_total[5m]))",
+                    "{{tenant}}",
+                ),
+            ],
+            unit="ops", y=16, pid=5,
+        ),
+        panel(
+            "Tenant sheds by reason",
+            [
+                (
+                    "sum by (tenant, reason) (rate(lodestar_offload_tenant_shed_total[5m]))",
+                    "{{tenant}} {{reason}}",
+                ),
+            ],
+            unit="ops", x=12, y=16, pid=6,
+        ),
+        panel(
+            "Tenant in-flight grants vs quota weight",
+            [
+                ("lodestar_offload_tenant_inflight", "inflight {{tenant}}"),
+                ("lodestar_offload_tenant_quota_weight", "weight {{tenant}}"),
+            ],
+            y=24, pid=7,
+        ),
+    ]
+    return dashboard(
+        "lodestar-mesh-serving",
+        "Lodestar TPU - Multi-chip serving",
+        ps,
+        ["lodestar", "mesh", "tenancy"],
+    )
+
+
 def all_dashboards():
     return (
         ("lodestar_bls_verifier_pool.json", bls_pool()),
@@ -308,6 +382,7 @@ def all_dashboards():
         ("lodestar_offload_audit.json", audit_dashboard()),
         ("lodestar_ssz_htr.json", ssz_htr_dashboard()),
         ("lodestar_node_internals.json", node_internals_dashboard()),
+        ("lodestar_mesh_serving.json", mesh_serving_dashboard()),
     )
 
 
