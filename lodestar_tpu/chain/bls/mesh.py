@@ -36,6 +36,7 @@ from lodestar_tpu.scheduler import OccupancyTracker
 __all__ = [
     "MeshLane",
     "VerifierMesh",
+    "PreparedSets",
     "build_device_mesh",
     "single_lane_mesh",
     "mesh_launch",
@@ -71,13 +72,33 @@ SHARD_MIN_SETS_PER_LANE = 16
 SHARD_DISABLE_THRESHOLD = 3
 
 
+class PreparedSets:
+    """Staged prep output for one launch unit (the pipelined pool's
+    hand-off between its prep and verify stages).
+
+    `inputs` is the `build_device_inputs` tuple, or None when prep
+    REJECTED the batch (a structural verdict — final, never re-prepped).
+    `error` carries a prep-stage exception; a launch seeing one re-preps
+    through the lane's plain `verify_fn`, which re-raises through the
+    exact pre-pipeline fail-closed path."""
+
+    __slots__ = ("inputs", "error", "info")
+
+    def __init__(self, inputs=None, error: Exception | None = None, info=None):
+        self.inputs = inputs
+        self.error = error
+        self.info = info  # prep span record carried across threads
+
+
 class MeshLane:
     """One device lane: verify callable + occupancy + wedge breaker.
 
     `inflight` is dispatcher state (how many packages the pool has in
     flight on this lane) and is only touched on the event loop; the
     occupancy tracker and breaker are thread-safe because the launches
-    themselves run on executor threads."""
+    themselves run on executor threads. `verify_prepared_fn` (optional)
+    verifies a `PreparedSets.inputs` tuple staged by the pipelined
+    pool's prep stage; lanes without one always re-prep inline."""
 
     def __init__(
         self,
@@ -87,12 +108,14 @@ class MeshLane:
         label: str | None = None,
         wedge_threshold: int = LANE_WEDGE_THRESHOLD,
         wedge_reset_s: float = LANE_WEDGE_RESET_S,
+        verify_prepared_fn: Callable | None = None,
     ) -> None:
         from lodestar_tpu.offload.resilience import CircuitBreaker
 
         self.index = index
         self.label = label if label is not None else f"dev{index}"
         self.verify_fn = verify_fn
+        self.verify_prepared_fn = verify_prepared_fn
         self.occupancy = OccupancyTracker()
         self.breaker = CircuitBreaker(
             failure_threshold=wedge_threshold,
@@ -182,6 +205,7 @@ def mesh_launch(
     prefer: MeshLane | None = None,
     on_launch: Callable | None = None,
     on_wedge: Callable | None = None,
+    prepared: "PreparedSets | None" = None,
 ) -> tuple[bool, MeshLane]:
     """One verify launch with per-lane wedge accounting and cross-lane
     error retry — the single-launch core shared by the pool's executor
@@ -194,7 +218,14 @@ def mesh_launch(
     closed→open transition — and retries on each remaining available
     sibling, least-occupied first; the verdict is unchanged and the
     call raises only when every candidate errored. `on_launch(lane)`
-    fires per attempt (metrics). Returns (ok, lane_that_served)."""
+    fires per attempt (metrics). Returns (ok, lane_that_served).
+
+    `prepared` (pipelined pool) short-circuits the prep half: a staged
+    structural REJECT is the final verdict (ok=False, no re-prep); clean
+    staged inputs go through the lane's `verify_prepared_fn`; a staged
+    prep ERROR — or a lane without a prepared callable — re-preps
+    through the plain `verify_fn`, so the fail-closed degradation chain
+    is byte-for-byte the pre-pipeline one."""
     if prefer is None or (prefer.wedged and mesh.available()):
         # no preference, or the preferred lane wedged since dispatch
         # (mid-package: chunk N trips the breaker, chunk N+1 must not
@@ -207,8 +238,20 @@ def mesh_launch(
         tried.append(current)
         try:
             with current.occupancy.launch():
-                ok = bool(current.verify_fn(sets))
+                use_staged = prepared is not None and prepared.error is None
+                if use_staged and prepared.inputs is None:
+                    ok = False  # prep rejected the batch: verdict final
+                elif use_staged and current.verify_prepared_fn is not None:
+                    ok = bool(current.verify_prepared_fn(prepared.inputs))
+                else:
+                    ok = bool(current.verify_fn(sets))
         except Exception:
+            # an error on a staged-inputs attempt may be input-bound
+            # (arrays committed to the sick die, a malformed staging) —
+            # sibling retries re-prep inline so the cross-lane recovery
+            # is exactly the pre-pipeline one, not N copies of the same
+            # poisoned inputs wedging every healthy breaker
+            prepared = None
             was_open = current.breaker.is_open
             current.breaker.record_failure()
             if not was_open and current.breaker.is_open:
@@ -231,10 +274,22 @@ def mesh_launch(
 
 
 def single_lane_mesh(
-    verify_fn: Callable, *, wedge_threshold: int = LANE_WEDGE_THRESHOLD
+    verify_fn: Callable,
+    *,
+    wedge_threshold: int = LANE_WEDGE_THRESHOLD,
+    verify_prepared_fn: Callable | None = None,
 ) -> VerifierMesh:
     """The pre-mesh shape: one lane, no sharded collective."""
-    return VerifierMesh([MeshLane(0, verify_fn, wedge_threshold=wedge_threshold)])
+    return VerifierMesh(
+        [
+            MeshLane(
+                0,
+                verify_fn,
+                wedge_threshold=wedge_threshold,
+                verify_prepared_fn=verify_prepared_fn,
+            )
+        ]
+    )
 
 
 def build_device_mesh(
@@ -256,13 +311,16 @@ def build_device_mesh(
 
     def _single() -> VerifierMesh:
         fn = fallback_verify_fn
+        prepared_fn = None
         if fn is None:
             try:
                 from lodestar_tpu.models.batch_verify import (
+                    verify_prepared,
                     verify_signature_sets_device,
                 )
 
                 fn = verify_signature_sets_device
+                prepared_fn = verify_prepared
             except Exception:
                 # a host without a usable jax stack (the standalone
                 # offload server historically served the pure-CPU
@@ -270,7 +328,9 @@ def build_device_mesh(
                 from lodestar_tpu.crypto.bls.api import verify_signature_sets
 
                 fn = verify_signature_sets
-        return single_lane_mesh(fn, wedge_threshold=wedge_threshold)
+        return single_lane_mesh(
+            fn, wedge_threshold=wedge_threshold, verify_prepared_fn=prepared_fn
+        )
 
     if mode == "off":
         return _single()
@@ -286,7 +346,12 @@ def build_device_mesh(
         if n <= 1:
             return _single()
         lanes = [
-            MeshLane(i, bv.make_lane_verify_fn(i), wedge_threshold=wedge_threshold)
+            MeshLane(
+                i,
+                bv.make_lane_verify_fn(i),
+                wedge_threshold=wedge_threshold,
+                verify_prepared_fn=bv.make_lane_verify_prepared_fn(i),
+            )
             for i in range(n)
         ]
         return VerifierMesh(lanes, sharded_fn=bv.make_mesh_sharded_fn())

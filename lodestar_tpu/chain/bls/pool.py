@@ -57,6 +57,8 @@ enumerated per device). Tests inject multi-lane topologies via `mesh=`.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import threading
 import time
 from typing import Awaitable, Callable, Sequence
 
@@ -76,6 +78,7 @@ from .mesh import (
     MESH_MODES,
     SHARD_MIN_SETS_PER_LANE,
     MeshLane,
+    PreparedSets,
     VerifierMesh,
     build_device_mesh,
     single_lane_mesh,
@@ -89,7 +92,17 @@ __all__ = [
     "MAX_BUFFER_WAIT_MS",
     "MAX_JOBS_CAN_ACCEPT_WORK",
     "BATCHABLE_MIN_PER_CHUNK",
+    "PIPELINE_MODES",
 ]
+
+#: prep→verify pipeline modes (--bls-pipeline): "auto" double-buffers
+#: only when the mesh has a sibling lane to stage prep on (a 1-lane /
+#: no-mesh pool keeps the exact pre-pipeline launch schedule), "on"
+#: forces the overlap even on one chip (prep of batch k+1 interleaves
+#: with the verify of batch k on the same die — the host byte work and
+#: the prep launches slot into the verify program's gaps), "off" keeps
+#: prep inline with the launch.
+PIPELINE_MODES = ("auto", "on", "off")
 
 # tuning constants — same values/rationale as the reference (index.ts:30-62)
 MAX_SIGNATURE_SETS_PER_JOB = 128
@@ -145,6 +158,99 @@ class _Job:
         self.added_ns = time.monotonic_ns() if self.trace_parent is not None else 0
 
 
+class _OverlapTracker:
+    """Wall-clock pipeline accounting: how much of the verify stages'
+    busy time had a prep stage in flight — the number behind the
+    `prep_verify_overlap_occupancy_pct` bench line (and the tier-1
+    overlap test). Count-based interval algebra: every begin/end of
+    either stage advances the three accumulators by the elapsed window,
+    attributed to whichever stages were active during it."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._prep_n = 0  # guarded by: _lock
+        self._verify_n = 0  # guarded by: _lock
+        self._last_ns = 0  # guarded by: _lock
+        self._prep_ns = 0  # guarded by: _lock
+        self._verify_ns = 0  # guarded by: _lock
+        self._overlap_ns = 0  # guarded by: _lock
+
+    def _transition(self, dprep: int, dverify: int) -> None:
+        with self._lock:
+            now = time.monotonic_ns()
+            if self._last_ns:
+                dt = now - self._last_ns
+                if self._prep_n:
+                    self._prep_ns += dt
+                if self._verify_n:
+                    self._verify_ns += dt
+                if self._prep_n and self._verify_n:
+                    self._overlap_ns += dt
+            self._last_ns = now
+            self._prep_n += dprep
+            self._verify_n += dverify
+
+    @contextlib.contextmanager
+    def prep(self):
+        self._transition(1, 0)
+        try:
+            yield
+        finally:
+            self._transition(-1, 0)
+
+    @contextlib.contextmanager
+    def verify(self):
+        self._transition(0, 1)
+        try:
+            yield
+        finally:
+            self._transition(0, -1)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "prep_ns": self._prep_ns,
+                "verify_ns": self._verify_ns,
+                "overlap_ns": self._overlap_ns,
+            }
+
+
+class _PrepUnit:
+    """One staged launch unit: the jobs it covers, their flattened sets,
+    and the prep outcome (PreparedSets: inputs / reject / error)."""
+
+    __slots__ = ("jobs", "sets", "prepared")
+
+    def __init__(self, jobs: list[_Job], sets: list, prepared: PreparedSets):
+        self.jobs = jobs
+        self.sets = sets
+        self.prepared = prepared
+
+
+class _PreppedPackage:
+    """Staged launch units for one package (the package itself and its
+    class ride the _Staged entry — this is just the prep output)."""
+
+    __slots__ = ("chunks", "singles")
+
+    def __init__(self, chunks, singles):
+        self.chunks = chunks  # batchable RLC chunks, prep staged
+        self.singles = singles  # non-batchable jobs, prep staged
+
+
+class _Staged:
+    """Staging-queue entry: the dequeued package plus the (possibly
+    still-running) prep future; `prep` is None for bulk packages, which
+    keep the inline-prep sharded road."""
+
+    __slots__ = ("package", "cls", "prep")
+
+    def __init__(self, package, cls, prep):
+        self.package = package
+        self.cls = cls
+        self.prep = prep
+
+
 class BlsDeviceVerifierPool(IBlsVerifier):
     def __init__(
         self,
@@ -157,6 +263,8 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         sched_metrics=None,
         mesh: VerifierMesh | None = None,
         mesh_mode: str | None = None,
+        pipeline: str = "auto",
+        prep_fn: Callable | None = None,
     ) -> None:
         explicit_fn = verify_fn is not None
         if verify_fn is None:
@@ -182,9 +290,50 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 mesh_mode, wedge_threshold=DEVICE_WEDGE_THRESHOLD
             )
         else:
+            prepared_fn = None
+            if not explicit_fn:
+                # the default backend can verify staged inputs directly;
+                # an injected mock only speaks sets, so its lane leaves
+                # the prepared seam unset and mesh_launch re-preps inline
+                from lodestar_tpu.models.batch_verify import verify_prepared
+
+                prepared_fn = verify_prepared
             self.mesh = single_lane_mesh(
-                verify_fn, wedge_threshold=DEVICE_WEDGE_THRESHOLD
+                verify_fn,
+                wedge_threshold=DEVICE_WEDGE_THRESHOLD,
+                verify_prepared_fn=prepared_fn,
             )
+
+        # prep→verify double buffering: stage prep of package k+1 while
+        # the lanes verify package k. "auto" engages only with a sibling
+        # lane to stage on — the 1-lane default keeps the pre-pipeline
+        # launch schedule exactly (regression-tested). Staging also
+        # requires lanes that can CONSUME staged inputs (or an injected
+        # prep_fn): a mesh of plain verify callables would pay real prep
+        # for inputs nobody uses — and a prep-stage structural reject
+        # would overrule a backend that never saw the sets
+        if pipeline not in PIPELINE_MODES:
+            raise ValueError(
+                f"bls_pipeline must be one of {PIPELINE_MODES}, got {pipeline!r}"
+            )
+        self.pipeline_mode = pipeline
+        stageable = prep_fn is not None or all(
+            lane.verify_prepared_fn is not None for lane in self.mesh.lanes
+        )
+        if pipeline == "on" and not stageable:
+            self._log.warn(
+                "bls pipeline forced on but no lane can verify staged inputs; "
+                "running unpipelined"
+            )
+        self._pipeline_enabled = stageable and (
+            pipeline == "on" or (pipeline == "auto" and len(self.mesh) > 1)
+        )
+        self._prep_fn = prep_fn if prep_fn is not None else self._default_prep_fn
+        self._staged_q: asyncio.Queue | None = None  # guarded by: event-loop (built by _ensure_runner)
+        self._stage_slot: asyncio.Semaphore | None = None  # guarded by: event-loop (built by _ensure_runner)
+        self._verify_runner: asyncio.Task | None = None  # guarded by: event-loop (single-threaded)
+        self._overlap = _OverlapTracker()
+        self._staged_packages = 0  # guarded by: advisory-only (monotonic count, prep threads under the GIL)
 
         self.scheduler_enabled = scheduler_enabled
         self._sched_metrics = sched_metrics
@@ -300,6 +449,31 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             except asyncio.CancelledError:
                 pass
             self._runner = None
+        if self._verify_runner is not None:
+            self._verify_runner.cancel()
+            try:
+                await self._verify_runner
+            except asyncio.CancelledError:
+                pass
+            self._verify_runner = None
+        # drain the staging queue: a package parked between the prep and
+        # verify stages has no other owner left to fail its futures (and
+        # its still-running prep future nobody left to await — consume
+        # the eventual outcome so a late prep error isn't logged as an
+        # unretrieved exception at shutdown)
+        if self._staged_q is not None:
+            while True:
+                try:
+                    staged = self._staged_q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if staged.prep is not None:
+                    staged.prep.add_done_callback(
+                        lambda f: f.cancelled() or f.exception()
+                    )
+                for job in staged.package:
+                    if not job.future.done():
+                        job.future.set_exception(err)
         # in-flight launches: cancel the awaiting tasks (the executor
         # threads run to completion and resolve futures thread-safe,
         # exactly like the pre-mesh abandoned run_in_executor)
@@ -312,8 +486,22 @@ class BlsDeviceVerifierPool(IBlsVerifier):
     # -- queueing -------------------------------------------------------------
 
     def _ensure_runner(self) -> None:
-        if self._runner is None or self._runner.done():
-            self._runner = asyncio.get_event_loop().create_task(self._run_jobs())
+        loop = asyncio.get_event_loop()
+        if self._pipeline_enabled:
+            # BOTH stage tasks self-heal independently: a dead dispatch
+            # stage with a live staging stage would otherwise fill the
+            # 1-deep queue and hang every later verify with no restart
+            if self._staged_q is None:
+                # depth 1 IS the double buffer: one package staged
+                # (prep in flight) beyond whatever is launching
+                self._staged_q = asyncio.Queue(maxsize=1)
+                self._stage_slot = asyncio.Semaphore(1)
+            if self._runner is None or self._runner.done():
+                self._runner = loop.create_task(self._stage_jobs())
+            if self._verify_runner is None or self._verify_runner.done():
+                self._verify_runner = loop.create_task(self._dispatch_staged())
+        elif self._runner is None or self._runner.done():
+            self._runner = loop.create_task(self._run_jobs())
 
     def _enqueue(self, job: _Job) -> _Job:
         self._outstanding += 1
@@ -412,60 +600,213 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         lane = min(free, key=lambda l: (l.wedged, l.occupancy.occupancy()))
         return "single", [lane]
 
+    async def _next_package(self) -> tuple[list[_Job], PriorityClass]:
+        """Dequeue one job and drain immediately-available work into the
+        package: same class only under the scheduler, capped at
+        MAX_PACKAGE_SETS (and bulk runs ONE job per package) — both
+        bound how long an arriving gossip block can wait behind the
+        in-flight launch; everything available in FIFO mode (the
+        pre-scheduler arm)."""
+        job, cls, waited_ns = await self._jobs.get()
+        self._record_sched_dequeue(job, cls, waited_ns)
+        package = [job]
+        if not (self.scheduler_enabled and cls in BULK_CLASSES):
+            drain_cls = cls if self.scheduler_enabled else None
+            package_sets = len(job.sets)
+            while not self.scheduler_enabled or package_sets < MAX_PACKAGE_SETS:
+                nxt = self._jobs.get_nowait(drain_cls)
+                if nxt is None:
+                    break
+                self._record_sched_dequeue(*nxt)
+                package.append(nxt[0])
+                package_sets += len(nxt[0].sets)
+        return package, cls
+
+    async def _place_and_launch(self, package, cls, prepped=None) -> None:
+        """Shared dispatch tail: the in-hand wait-for-capacity /
+        placement / launch-task sequence, with the in-hand cancellation
+        contract — from here to create_task, any await must fail the
+        package's futures on cancellation (close() only drains the
+        queue, it cannot see this package)."""
+        try:
+            while True:
+                free = self._free_lanes()
+                if free:
+                    break
+                # a free lane wedged between the capacity check and
+                # placement (a cross-lane retry on an executor
+                # thread can trip any breaker): healthy lanes exist
+                # but are busy — their in-flight completions set
+                # _lane_free, so this wait always terminates
+                self._lane_free.clear()
+                await self._lane_free.wait()
+                if self._closed:
+                    raise asyncio.CancelledError("bls pool closed")
+            mode, lanes = self._pick_placement(cls, package, free)
+        except asyncio.CancelledError:
+            err = asyncio.CancelledError("bls pool closed")
+            for j in package:
+                if not j.future.done():
+                    j.future.set_exception(err)
+            raise
+        for lane in lanes:
+            lane.inflight += 1
+        task = asyncio.get_event_loop().create_task(
+            self._launch(package, mode, lanes, prepped=prepped)
+        )
+        self._launch_tasks.add(task)
+        task.add_done_callback(self._launch_tasks.discard)
+
     async def _run_jobs(self) -> None:
         while not self._closed:
             await self._wait_free_lane()
             if self._closed:
                 return
-            job, cls, waited_ns = await self._jobs.get()
-            self._record_sched_dequeue(job, cls, waited_ns)
-            package = [job]
-            # drain immediately-available work into the package: same
-            # class only under the scheduler, capped at MAX_PACKAGE_SETS
-            # (and bulk runs ONE job per package) — both bound how long an
-            # arriving gossip block can wait behind the in-flight launch;
-            # everything available in FIFO mode (the pre-scheduler arm)
-            if not (self.scheduler_enabled and cls in BULK_CLASSES):
-                drain_cls = cls if self.scheduler_enabled else None
-                package_sets = len(job.sets)
-                while not self.scheduler_enabled or package_sets < MAX_PACKAGE_SETS:
-                    nxt = self._jobs.get_nowait(drain_cls)
-                    if nxt is None:
-                        break
-                    self._record_sched_dequeue(*nxt)
-                    package.append(nxt[0])
-                    package_sets += len(nxt[0].sets)
-            # a package is now IN HAND: from here to create_task, any
-            # await must fail the package's futures on cancellation —
-            # close() only drains the queue, it cannot see this package
+            package, cls = await self._next_package()
+            await self._place_and_launch(package, cls)
+
+    # -- prep→verify pipeline (dispatcher split into two stages) ---------------
+
+    async def _stage_jobs(self) -> None:
+        """Pipeline stage 1: reserve the staging slot, dequeue, submit
+        prep to an executor thread, hand the package to the verify
+        dispatcher through the 1-deep staging queue. The slot is
+        acquired BEFORE the dequeue, so package k+2 is not even taken
+        out of the priority queue until the dispatcher consumed k+1 —
+        the lookahead beyond the in-flight launches is exactly one
+        package, the same bound the pre-pipeline dispatcher's in-hand
+        package had."""
+        loop = asyncio.get_event_loop()
+        while not self._closed:
+            await self._stage_slot.acquire()
+            if self._closed:
+                self._stage_slot.release()
+                return
             try:
-                while True:
-                    free = self._free_lanes()
-                    if free:
-                        break
-                    # a free lane wedged between the capacity check and
-                    # placement (a cross-lane retry on an executor
-                    # thread can trip any breaker): healthy lanes exist
-                    # but are busy — their in-flight completions set
-                    # _lane_free, so this wait always terminates
-                    self._lane_free.clear()
-                    await self._lane_free.wait()
-                    if self._closed:
-                        raise asyncio.CancelledError("bls pool closed")
-                mode, lanes = self._pick_placement(cls, package, free)
-            except asyncio.CancelledError:
-                err = asyncio.CancelledError("bls pool closed")
+                package, cls = await self._next_package()
+            except BaseException:
+                # nothing dequeued: release the slot so a restarted
+                # stage loop (the self-heal contract) isn't deadlocked
+                # on a permit this dead task took to its grave
+                self._stage_slot.release()
+                raise
+            try:
+                if self.scheduler_enabled and cls in BULK_CLASSES:
+                    # bulk may shard across lanes; the collective launch
+                    # preps inline exactly like the unpipelined pool
+                    prep = None
+                else:
+                    prep = loop.run_in_executor(
+                        None, self._prep_package, package
+                    )
+                # the slot reservation guarantees room: never blocks
+                self._staged_q.put_nowait(_Staged(package, cls, prep))
+            except BaseException as e:
+                # ANY failure here (cancellation, an executor refusing
+                # work at shutdown, ...) must fail the in-hand package's
+                # futures — no one else can see it — and return the
+                # staging permit before the task dies
+                self._stage_slot.release()
+                err = (
+                    asyncio.CancelledError("bls pool closed")
+                    if isinstance(e, asyncio.CancelledError)
+                    else e
+                )
                 for j in package:
                     if not j.future.done():
                         j.future.set_exception(err)
                 raise
-            for lane in lanes:
-                lane.inflight += 1
-            task = asyncio.get_event_loop().create_task(
-                self._launch(package, mode, lanes)
-            )
-            self._launch_tasks.add(task)
-            task.add_done_callback(self._launch_tasks.discard)
+
+    async def _dispatch_staged(self) -> None:
+        """Pipeline stage 2: wait for lane capacity, take the staged
+        package (releasing the staging slot), await its prep, place and
+        launch. Placement policy, verdict semantics, and the
+        fail-closed chain are the unpipelined dispatcher's — only the
+        prep wall time moved off the critical path."""
+        while not self._closed:
+            await self._wait_free_lane()
+            if self._closed:
+                return
+            staged = await self._staged_q.get()
+            self._stage_slot.release()
+            try:
+                prepped = await staged.prep if staged.prep is not None else None
+            except asyncio.CancelledError:
+                err = asyncio.CancelledError("bls pool closed")
+                for j in staged.package:
+                    if not j.future.done():
+                        j.future.set_exception(err)
+                raise
+            except Exception as e:  # prep infrastructure failure: fail closed
+                for j in staged.package:
+                    if not j.future.done():
+                        j.future.set_exception(e)
+                continue
+            await self._place_and_launch(staged.package, staged.cls, prepped=prepped)
+
+    def _default_prep_fn(self, sets: list[SignatureSet], lane_hint: int | None):
+        from lodestar_tpu.models.batch_verify import prepare_inputs_for_lane
+
+        return prepare_inputs_for_lane(sets, lane_hint)
+
+    def _prep_lane_hint(self) -> int | None:
+        """A free sibling lane to stage prep on (mesh with >1 chip);
+        None interleaves prep on whatever chip is current. Advisory
+        read of dispatcher-owned state from the prep thread: a stale
+        pick costs placement quality, never correctness."""
+        if len(self.mesh.lanes) < 2:
+            return None
+        free = [l for l in self.mesh.available() if l.inflight == 0]
+        if not free:
+            return None
+        return min(free, key=lambda l: l.occupancy.occupancy()).index
+
+    def _prep_unit(self, jobs: list[_Job], sets: list) -> _PrepUnit:
+        """Stage prep for one launch unit (prep executor thread). Errors
+        are CAPTURED, not raised: the launch re-preps through the plain
+        verify path so a prep fault takes the exact pre-pipeline
+        degradation road (device→host inside build_device_inputs;
+        anything worse raises at launch time and fails closed)."""
+        from lodestar_tpu.models.batch_verify import consume_prep_info
+
+        t0_ns = time.monotonic_ns()
+        inputs = None
+        error: Exception | None = None
+        with self._overlap.prep():
+            try:
+                inputs = self._prep_fn(sets, self._prep_lane_hint())
+            except Exception as e:
+                error = e
+        info = consume_prep_info()
+        if info is not None and info["end_ns"] < t0_ns:
+            info = None  # stale record from an earlier launch on this thread
+        return _PrepUnit(jobs, sets, PreparedSets(inputs, error, info))
+
+    def _prep_package(self, package: list[_Job]) -> _PreppedPackage:
+        """Prep every launch unit the verify stage will dispatch: the
+        RLC chunks of the batchable jobs plus each non-batchable job —
+        the same unit boundaries `_verify_package` launches, so the
+        launch schedule is unchanged."""
+        self._staged_packages += 1
+        batchable = [j for j in package if j.batchable]
+        individual = [j for j in package if not j.batchable]
+        chunks = [
+            self._prep_unit(chunk, [s for j in chunk for s in j.sets])
+            for chunk in chunkify_maximize_chunk_size(batchable, BATCHABLE_MIN_PER_CHUNK)
+        ]
+        singles = [self._prep_unit([j], j.sets) for j in individual]
+        return _PreppedPackage(chunks, singles)
+
+    def pipeline_stats(self) -> dict:
+        """Pipeline wall-clock accounting: prep/verify busy time, their
+        overlap, the overlap share of verify time, and the staged
+        package count (0 = pipeline never engaged)."""
+        s = self._overlap.snapshot()
+        v = s["verify_ns"]
+        s["overlap_occupancy_pct"] = (100.0 * s["overlap_ns"] / v) if v else 0.0
+        s["staged_packages"] = self._staged_packages
+        s["pipeline_enabled"] = self._pipeline_enabled
+        return s
 
     def _release_lanes_early(self, to_release: list[MeshLane], held: list[MeshLane]) -> None:
         """Loop-side early release: the sharded fallback returns unused
@@ -478,16 +819,31 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 lane.inflight -= 1
         self._lane_free.set()
 
-    async def _launch(self, package: list[_Job], mode: str, lanes: list[MeshLane]) -> None:
+    def _with_verify_window(self, fn, *args) -> None:
+        """Executor-thread entry: every verify path runs inside the
+        overlap tracker's verify window (the denominator of the
+        pipeline's overlap-occupancy number)."""
+        with self._overlap.verify():
+            fn(*args)
+
+    async def _launch(
+        self,
+        package: list[_Job],
+        mode: str,
+        lanes: list[MeshLane],
+        prepped: _PreppedPackage | None = None,
+    ) -> None:
         held = list(lanes)  # guarded by: event-loop (early releases and the finally both run on the loop)
         try:
             if mode == "sharded":
                 await asyncio.get_event_loop().run_in_executor(
-                    None, self._verify_package_sharded, package, lanes, held
+                    None, self._with_verify_window,
+                    self._verify_package_sharded, package, lanes, held,
                 )
             else:
                 await asyncio.get_event_loop().run_in_executor(
-                    None, self._verify_package, package, lanes[0]
+                    None, self._with_verify_window,
+                    self._verify_package, package, lanes[0], False, prepped,
                 )
         except asyncio.CancelledError:
             # close() cancels launch tasks; if the executor work item
@@ -531,12 +887,18 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         if m is not None:
             m.lane_launches.labels(lane.label, mode).inc()
 
-    def _launch_sets(self, lane: MeshLane, sets: list[SignatureSet]):
+    def _launch_sets(
+        self,
+        lane: MeshLane,
+        sets: list[SignatureSet],
+        prepared: PreparedSets | None = None,
+    ):
         """One verify launch, preferring `lane` (mesh_launch: breaker
         accounting + cross-lane error retry — a sick chip degrades its
         work onto the rest of the mesh with the verdict unchanged;
         raises only when every candidate lane errored, which with one
-        lane is exactly the pre-mesh fail-closed behavior). Returns
+        lane is exactly the pre-mesh fail-closed behavior). `prepared`
+        carries staged pipeline inputs (see mesh_launch). Returns
         (ok, lane_that_served)."""
         from .mesh import mesh_launch
 
@@ -544,12 +906,26 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             self.mesh,
             sets,
             prefer=lane,
+            prepared=prepared,
             on_launch=lambda l: self._count_lane_launch(l, "single"),
             on_wedge=self._on_lane_wedge,
         )
 
-    def _verify_package(self, package: list[_Job], lane: MeshLane, counted: bool = False) -> None:
-        """Runs in a thread executor (device dispatch releases the GIL)."""
+    def _verify_package(
+        self,
+        package: list[_Job],
+        lane: MeshLane,
+        counted: bool = False,
+        prepped: _PreppedPackage | None = None,
+    ) -> None:
+        """Runs in a thread executor (device dispatch releases the GIL).
+
+        `prepped` carries the pipeline's staged launch units — the SAME
+        unit boundaries as the inline path, so the launch schedule is
+        identical; only where prep ran differs. The batch-then-retry
+        road always re-preps INLINE (fresh blinding, fresh prep — one
+        bad signature can't poison its neighbors, and a stale staged
+        prep can't poison the retry)."""
         if not counted:
             self.metrics["jobs_started"] += len(package)
             self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
@@ -571,49 +947,87 @@ class BlsDeviceVerifierPool(IBlsVerifier):
 
         batchable = [j for j in package if j.batchable]
         individual = [j for j in package if not j.batchable]
+        if prepped is None:
+            chunk_units = [
+                (chunk, [s for j in chunk for s in j.sets], None)
+                for chunk in chunkify_maximize_chunk_size(
+                    batchable, BATCHABLE_MIN_PER_CHUNK
+                )
+            ]
+            single_units = [([j], j.sets, None) for j in individual]
+        else:
+            chunk_units = [(u.jobs, u.sets, u.prepared) for u in prepped.chunks]
+            single_units = [(u.jobs, u.sets, u.prepared) for u in prepped.singles]
 
         # RLC-batch the batchable jobs in ≥16-set chunks; invalid batch →
         # retry each job individually (worker.ts:52-96)
         from lodestar_tpu.utils.tracing import trace_region
 
-        for chunk in chunkify_maximize_chunk_size(batchable, BATCHABLE_MIN_PER_CHUNK):
-            all_sets = [s for j in chunk for s in j.sets]
+        retries: list[_Job] = []
+        for jobs, all_sets, staged in chunk_units:
             t0 = time.monotonic_ns() if traced else 0
             try:
                 with trace_region("bls_batch_verify"):
-                    ok, served = self._launch_sets(lane, all_sets)
+                    ok, served = self._launch_sets(lane, all_sets, prepared=staged)
             except Exception:
                 self.metrics["batch_retries"] += 1
                 if traced:
-                    self._trace_prep(chunk, t0)
-                    self._trace_launch(chunk, t0, len(all_sets), "batch_error", lane.label)
-                individual.extend(chunk)
+                    self._trace_unit_prep(jobs, staged, t0)
+                    self._trace_launch(jobs, t0, len(all_sets), "batch_error", lane.label)
+                retries.extend(jobs)
                 continue
             if traced:
-                self._trace_prep(chunk, t0)
-                self._trace_launch(chunk, t0, len(all_sets), "batch", served.label)
+                self._trace_unit_prep(jobs, staged, t0)
+                self._trace_launch(jobs, t0, len(all_sets), "batch", served.label)
             if ok:
                 self.metrics["batch_sigs_success"] += len(all_sets)
-                for j in chunk:
+                for j in jobs:
                     self._resolve(j, True)
             else:
                 self.metrics["batch_retries"] += 1
-                individual.extend(chunk)
+                retries.extend(jobs)
 
-        for j in individual:
+        for jobs, sets_, staged in single_units + [([j], j.sets, None) for j in retries]:
+            j = jobs[0]
             t0 = time.monotonic_ns() if traced else 0
             try:
-                ok, served = self._launch_sets(lane, j.sets)
+                ok, served = self._launch_sets(lane, sets_, prepared=staged)
                 if traced:
-                    self._trace_prep([j], t0)
-                    self._trace_launch([j], t0, len(j.sets), "single", served.label)
+                    self._trace_unit_prep([j], staged, t0)
+                    self._trace_launch([j], t0, len(sets_), "single", served.label)
                 self._resolve(j, ok)
             except Exception as e:
                 if traced:
-                    self._trace_prep([j], t0)
-                    self._trace_launch([j], t0, len(j.sets), "single_error", lane.label)
+                    self._trace_unit_prep([j], staged, t0)
+                    self._trace_launch([j], t0, len(sets_), "single_error", lane.label)
                 if not j.future.done():
                     j.future.get_loop().call_soon_threadsafe(self._reject, j, e)
+
+    def _trace_unit_prep(self, jobs: list[_Job], staged, t0: int) -> None:
+        """`bls_prep` span for one launch unit: from the thread-local
+        record for inline prep, or from the record the prep STAGE
+        carried across threads on its PreparedSets."""
+        if staged is None:
+            self._trace_prep(jobs, t0)
+        else:
+            self._trace_prep_info(jobs, staged.info)
+
+    @staticmethod
+    def _trace_prep_info(jobs: list[_Job], info) -> None:
+        """`bls_prep` span from a record the prep STAGE carried across
+        threads (the pipelined twin of `_trace_prep`, which reads the
+        launch thread's TLS): staged prep ran on the prep executor, so
+        the record rides the _PrepUnit instead."""
+        if info is None:
+            return
+        attrs = {"layer": info["layer"], "sets": info["sets"], "staged": True}
+        if info["rejected"]:
+            attrs["rejected"] = True
+        for j in jobs:
+            if j.trace_parent is not None:
+                tracing.record(
+                    j.trace_parent, "bls_prep", info["start_ns"], info["end_ns"], attrs
+                )
 
     def _verify_package_sharded(
         self, package: list[_Job], lanes: list[MeshLane], held: list[MeshLane] | None = None

@@ -63,6 +63,14 @@ def _add_scheduler_args(sp) -> None:
         "python oracle). Device-prep errors fall back to host prep.",
     )
     sp.add_argument(
+        "--bls-pipeline", choices=["auto", "on", "off"], default="auto",
+        help="double-buffer the BLS prep→verify pipeline: stage input prep "
+        "of batch k+1 while batch k verifies (auto = only when the mesh "
+        "has a sibling lane to prep on, on = overlap even on one chip, "
+        "off = prep inline with the launch). Verdicts, priority "
+        "placement, and the fail-closed degradation chain are unchanged.",
+    )
+    sp.add_argument(
         "--htr-device", choices=["auto", "on", "off"], default="auto",
         help="flush state hashTreeRoot dirty subtrees through the device "
         "SHA-256 kernel (one batched launch per tree level): auto = only "
@@ -352,6 +360,7 @@ async def _run_dev(args) -> int:
             offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
+            bls_pipeline=args.bls_pipeline,
             htr_device=args.htr_device,
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,
@@ -520,6 +529,7 @@ async def _run_beacon(args) -> int:
             offload_unquarantine=args.offload_unquarantine,
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
+            bls_pipeline=args.bls_pipeline,
             htr_device=args.htr_device,
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,

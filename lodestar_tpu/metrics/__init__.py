@@ -375,6 +375,7 @@ class BlsPrepMetrics:
     seconds: Histogram  # per-call prep wall time, labeled by layer
     fallbacks: Counter  # device-prep errors degraded to host prep
     rejected: Counter  # prep calls that rejected a structurally invalid batch
+    launches: Counter  # ALL device prep dispatches at ops/prep.py's dispatch seam
 
 
 @dataclass
@@ -501,6 +502,13 @@ def create_metrics() -> BeaconMetrics:
         rejected=c.counter(
             "lodestar_bls_prep_rejected_total",
             "Prep calls that rejected a structurally invalid batch",
+        ),
+        launches=c.counter(
+            "lodestar_bls_prep_launches_total",
+            "Device prep program dispatches (plain dispatch counter at the "
+            "ops/prep.py launch seam: fused-stage, per-leg, and "
+            "hash-to-G2 dispatches all count; the per-batch budget "
+            "invariant is asserted in tests against the same seam)",
         ),
     )
     ssz_htr = SszHtrMetrics(

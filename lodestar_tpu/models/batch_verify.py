@@ -59,9 +59,12 @@ __all__ = [
     "device_batch_verify_sharded",
     "make_synthetic_sets",
     "verify_signature_sets_device",
+    "verify_prepared",
+    "prepare_inputs_for_lane",
     "verify_signature_sets_sharded",
     "mesh_device_count",
     "make_lane_verify_fn",
+    "make_lane_verify_prepared_fn",
     "make_mesh_sharded_fn",
 ]
 
@@ -93,6 +96,14 @@ def configure_device_prep(mode: str | None = None, metrics=None) -> str:
         _prep_mode = mode
     if metrics is not None:
         _prep_metrics = metrics
+        # the launches counter increments at the dispatch site inside
+        # ops/prep.py (the only place that actually knows when a device
+        # program is launched) — hand it over here, the one config seam
+        launches = getattr(metrics, "launches", None)
+        if launches is not None:
+            from lodestar_tpu.ops import prep as _dp
+
+            _dp.configure_launch_counter(launches)
     return prev
 
 
@@ -236,15 +247,18 @@ def prepare_sets(sets: list[SignatureSet]):
     )
 
 
-def _prepare_sets_device_arrays(sets: list[SignatureSet], size: int):
+def _prepare_sets_device_arrays(sets: list[SignatureSet], size: int, fused: bool = True):
     """Device-resident prep on arrays padded to `size` (one compiled
     program per size class, same bucketing as the verify stages).
 
     Host work is byte-oriented only (flag parsing, limb unpacking,
     expand_message_xmd); every field op — decompression sqrt, subgroup
     checks, hash-to-field reduction, SSWU/isogeny/cofactor — runs in the
-    staged device programs of ops/prep.py. Returns (pk, h, sig, ok)
-    where ok is the all-sets-structurally-valid verdict (host bool)."""
+    staged device programs of ops/prep.py: `FUSED_PREP_LAUNCHES` counted
+    dispatches per batch on the production (fused) schedule; `fused=False`
+    keeps the pre-fusion one-launch-per-leg reference. Returns
+    (pk, h, sig, ok) where ok is the all-sets-structurally-valid verdict
+    (host bool)."""
     from lodestar_tpu.ops import prep as dp
 
     n = len(sets)
@@ -264,12 +278,9 @@ def _prepare_sets_device_arrays(sets: list[SignatureSet], size: int):
     sig_limbs, sig_sign, sig_struct = dp.parse_g2_compressed(dp.pad_rows(sig_raw, size))
     lo, hi = dp.hash_to_field_limbs(msgs + [msgs[0]] * (size - n))
 
-    pk_x, pk_y, pk_ok = dp.g1_decompress_subgroup(pk_limbs, pk_sign)
-    sig_x, sig_y, sig_ok = dp.g2_decompress_subgroup(sig_limbs, sig_sign)
-    u = dp.mont_from_wide(lo, hi)
-    jac = dp.map_to_g2_jac(u)
-    h_x, h_y = dp.hash_finish(
-        tuple(c[:, 0] for c in jac), tuple(c[:, 1] for c in jac)
+    prep_arrays = dp.prepare_arrays_fused if fused else dp.prepare_arrays_unfused
+    pk, pk_ok, sig, sig_ok, h = prep_arrays(
+        pk_limbs, pk_sign, sig_limbs, sig_sign, lo, hi
     )
 
     valid = (
@@ -278,18 +289,20 @@ def _prepare_sets_device_arrays(sets: list[SignatureSet], size: int):
         & np.asarray(pk_ok)[:n]
         & np.asarray(sig_ok)[:n]
     )
-    return (pk_x, pk_y), (h_x, h_y), (sig_x, sig_y), bool(valid.all())
+    return pk, h, sig, bool(valid.all())
 
 
-def prepare_sets_device(sets: list[SignatureSet]):
+def prepare_sets_device(sets: list[SignatureSet], fused: bool = True):
     """Device-path twin of `prepare_sets`: same contract (device-layout
     arrays or None if any set is structurally invalid), raw compressed
     bytes in, no per-set big-int math on the host. Internally padded to
-    the verify size classes so callers share compiled programs."""
+    the verify size classes so callers share compiled programs. The
+    fused schedule costs `ops.prep.FUSED_PREP_LAUNCHES` dispatches per
+    batch; `fused=False` runs the pre-fusion per-leg reference."""
     if not sets:
         return None
     n = len(sets)
-    pk, h, sig, ok = _prepare_sets_device_arrays(sets, _pad_pow2(n))
+    pk, h, sig, ok = _prepare_sets_device_arrays(sets, _pad_pow2(n), fused=fused)
     if not ok:
         return None
     return (
@@ -717,6 +730,33 @@ def verify_signature_sets_device(sets: list[SignatureSet]) -> bool:
     return bool(np.asarray(device_batch_verify(pk, h, sig, bits, mask)))
 
 
+def verify_prepared(inputs) -> bool:
+    """Verify a batch whose inputs were already staged by
+    `build_device_inputs` — the second half of the prep→verify pipeline
+    (chain/bls/pool.py double-buffers prep of batch k+1 against this
+    call on batch k). Blinding was sampled at prep time; the verdict is
+    identical to `verify_signature_sets_device` on the same sets."""
+    pk, h, sig, bits, mask = inputs
+    return bool(np.asarray(device_batch_verify(pk, h, sig, bits, mask)))
+
+
+def prepare_inputs_for_lane(sets: list[SignatureSet], lane_index: int | None = None):
+    """Pipeline prep stage: `build_device_inputs`, optionally pinned to
+    a sibling chip (`jax.default_device`) so staging batch k+1 doesn't
+    contend with the lane verifying batch k. A hint that doesn't resolve
+    to a device (mock lanes, single-device hosts) preps unpinned —
+    placement is an optimization, never a correctness seam."""
+    if lane_index is not None:
+        try:
+            dev = jax.devices()[lane_index]
+        except Exception:
+            dev = None
+        if dev is not None:
+            with jax.default_device(dev):
+                return build_device_inputs(sets)
+    return build_device_inputs(sets)
+
+
 def verify_signature_sets_sharded(sets: list[SignatureSet], mesh) -> bool:
     """End-to-end data-parallel batch verify over a device mesh."""
     n_dev = int(mesh.devices.size)
@@ -756,6 +796,21 @@ def make_lane_verify_fn(device_index: int):
 
     lane_verify.__name__ = f"lane_verify_dev{device_index}"
     return lane_verify
+
+
+def make_lane_verify_prepared_fn(device_index: int):
+    """Prepared-inputs twin of `make_lane_verify_fn`: the pipelined
+    pool's verify stage, pinned to one chip. Inputs staged on a sibling
+    device transfer on first use (jax moves committed arrays); the
+    verdict is placement-independent."""
+
+    def lane_verify_prepared(inputs) -> bool:
+        dev = jax.devices()[device_index]
+        with jax.default_device(dev):
+            return verify_prepared(inputs)
+
+    lane_verify_prepared.__name__ = f"lane_verify_prepared_dev{device_index}"
+    return lane_verify_prepared
 
 
 def make_mesh_sharded_fn():

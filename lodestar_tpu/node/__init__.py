@@ -54,6 +54,7 @@ class BeaconNodeOptions:
         offload_unquarantine: list[str] | None = None,
         scheduler_enabled: bool = True,
         bls_device_prep: str = "auto",
+        bls_pipeline: str = "auto",
         htr_device: str = "auto",
         bls_mesh: str = "auto",
         offload_tenant: str | None = None,
@@ -143,6 +144,18 @@ class BeaconNodeOptions:
                 f"bls_device_prep must be one of {PREP_MODES}, got {bls_device_prep!r}"
             )
         self.bls_device_prep = bls_device_prep
+        # prep→verify double buffering (chain/bls/pool.py): "auto"
+        # overlaps prep of batch k+1 with verify of batch k only when
+        # the mesh has a sibling lane; "on"/"off" force. Validated
+        # against the pool's canonical mode set (cli.py keeps a literal
+        # copy — argparse choices must not import the chain.bls package)
+        from lodestar_tpu.chain.bls.pool import PIPELINE_MODES
+
+        if bls_pipeline not in PIPELINE_MODES:
+            raise ValueError(
+                f"bls_pipeline must be one of {PIPELINE_MODES}, got {bls_pipeline!r}"
+            )
+        self.bls_pipeline = bls_pipeline
         # state hashTreeRoot placement (ssz/device_htr.py collector):
         # "auto" flushes dirty subtrees through the device SHA-256
         # kernel only when the Pallas backend is live; "on"/"off" force.
@@ -384,6 +397,7 @@ class BeaconNode:
                                 scheduler_enabled=opts.scheduler_enabled,
                                 sched_metrics=metrics.sched,
                                 mesh_mode=opts.bls_mesh,
+                                pipeline=opts.bls_pipeline,
                             ),
                         )
                     )
@@ -396,6 +410,7 @@ class BeaconNode:
                 scheduler_enabled=opts.scheduler_enabled,
                 sched_metrics=metrics.sched,
                 mesh_mode=opts.bls_mesh,
+                pipeline=opts.bls_pipeline,
             )
         else:
             bls = BlsSingleThreadVerifier()

@@ -96,20 +96,36 @@ class FakeLaneRig:
         wedge_threshold: int = 2,
         verdict_fn=None,
         with_sharded: bool = True,
+        with_prepared: bool = False,
     ) -> None:
         self.call_s = call_s
         self.verdict_fn = verdict_fn or (lambda sets: True)
         self._record_lock = threading.Lock()
         self.calls: list[tuple[int, int]] = []  # guarded by: _record_lock
+        self.prepared_calls: list[tuple[int, int]] = []  # guarded by: _record_lock
         self.sharded_calls: list[tuple[int, ...]] = []  # guarded by: _record_lock
         self.failing: set[int] = set()  # guarded by: _record_lock — lanes currently erroring
         lanes = [
-            MeshLane(i, self._make_lane_fn(i), wedge_threshold=wedge_threshold)
+            MeshLane(
+                i,
+                self._make_lane_fn(i),
+                wedge_threshold=wedge_threshold,
+                verify_prepared_fn=(
+                    self._make_prepared_fn(i) if with_prepared else None
+                ),
+            )
             for i in range(n_lanes)
         ]
         self.mesh = VerifierMesh(
             lanes, sharded_fn=self._sharded if with_sharded else None
         )
+
+    @staticmethod
+    def prep_fn(sets, lane_hint):
+        """Pool `prep_fn` seam twin: wraps the sets as staged 'inputs'
+        so the prepared lane callables can delegate to `verdict_fn` —
+        the pipeline invariants don't need real limb arrays."""
+        return ("prepped", list(sets), lane_hint)
 
     def _make_lane_fn(self, index: int):
         def lane_fn(sets):
@@ -123,6 +139,22 @@ class FakeLaneRig:
             return self.verdict_fn(sets)
 
         return lane_fn
+
+    def _make_prepared_fn(self, index: int):
+        def lane_prepared_fn(inputs):
+            tag, sets, _hint = inputs
+            assert tag == "prepped"
+            if self.call_s:
+                time.sleep(self.call_s)
+            with self._record_lock:
+                failing = index in self.failing
+                self.calls.append((index, len(sets)))
+                self.prepared_calls.append((index, len(sets)))
+            if failing:
+                raise RuntimeError(f"injected device error on dev{index}")
+            return self.verdict_fn(sets)
+
+        return lane_prepared_fn
 
     def _sharded(self, sets, device_indices):
         if self.call_s:

@@ -1,0 +1,10 @@
+"""Fixture consumer: a typo'd member access and an unknown value."""
+
+from .testing.faults import FaultKind
+
+RULES = [
+    FaultKind.LATENCY,  # fine
+    FaultKind.TYPO_KIND,  # names no declared member
+]
+
+BY_NAME = FaultKind("never_a_value")  # matches no member value

@@ -1,0 +1,22 @@
+"""Fixture registry for the fault-wiring rule: one undelivered member,
+one aliased value, plus (in sibling consumer.py) a typo'd attribute and
+an unknown string construction."""
+
+import enum
+
+
+class FaultKind(enum.Enum):
+    LATENCY = "latency"
+    RESET = "reset"
+    GHOST = "ghost"  # declared, never delivered below
+    SLOW = "latency"  # aliases LATENCY's value
+
+
+def _pre_call(kind):
+    if kind is FaultKind.LATENCY:
+        return "sleep"
+    if kind is FaultKind.RESET:
+        raise RuntimeError("reset")
+    if kind is FaultKind.SLOW:
+        return "sleep"
+    return None

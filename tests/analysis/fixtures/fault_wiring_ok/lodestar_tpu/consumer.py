@@ -1,0 +1,6 @@
+"""Fixture consumer: only declared members and values."""
+
+from .testing.faults import FaultKind
+
+RULES = [FaultKind.LATENCY, FaultKind.RESET]
+BY_NAME = FaultKind("reset")

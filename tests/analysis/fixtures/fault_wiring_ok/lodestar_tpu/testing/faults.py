@@ -1,0 +1,19 @@
+"""Fixture registry for the fault-wiring rule: fully wired."""
+
+import enum
+
+
+class FaultKind(enum.Enum):
+    LATENCY = "latency"
+    RESET = "reset"
+
+
+_BACKEND_KINDS = frozenset({FaultKind.LATENCY})
+
+
+def _pre_call(kind):
+    if kind is FaultKind.LATENCY:
+        return "sleep"
+    if kind is FaultKind.RESET:
+        raise RuntimeError("reset")
+    return None

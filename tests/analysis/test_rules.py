@@ -265,3 +265,48 @@ def test_rest_wiring_real_tree_is_clean():
         pragma_hygiene=False,
     )
     assert findings == [], [f.format() for f in findings]
+
+
+# -- fault-wiring (project-scoped) --------------------------------------------
+
+
+def fault_wiring_findings(root: str):
+    return analyze(
+        [],
+        rules=[RULES_BY_NAME["fault-wiring"]],
+        repo_root=FIXTURES / root,
+        pragma_hygiene=False,
+    )
+
+
+def test_fault_wiring_flags_every_gap_class():
+    msgs = [f.message for f in fault_wiring_findings("fault_wiring_bad")]
+    joined = " | ".join(msgs)
+    # registry -> delivery: declared member with no delivery branch
+    assert "FaultKind.GHOST is declared but never referenced" in joined
+    # registry hygiene: two members share one string value
+    assert "FaultKind.SLOW reuses value 'latency'" in joined
+    # consumers -> registry: typo'd attribute and unknown value
+    assert "FaultKind.TYPO_KIND names no declared member" in joined
+    assert 'FaultKind("never_a_value") matches no member value' in joined
+    # delivered members and known values stay quiet
+    assert not any(
+        m.startswith("FaultKind.LATENCY") or m.startswith("FaultKind.RESET")
+        for m in msgs
+    )
+    assert len(msgs) == 4, joined
+
+
+def test_fault_wiring_clean_tree():
+    assert fault_wiring_findings("fault_wiring_ok") == []
+
+
+def test_fault_wiring_real_tree_is_clean():
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    findings = analyze(
+        [],
+        rules=[RULES_BY_NAME["fault-wiring"]],
+        repo_root=repo,
+        pragma_hygiene=False,
+    )
+    assert findings == [], [f.format() for f in findings]

@@ -1,0 +1,377 @@
+"""Double-buffered prep→verify pipeline invariants (chain/bls/pool.py):
+
+* verdicts are bit-identical pipelined vs unpipelined (seeded replay),
+* prep of batch k+1 is in flight WHILE batch k verifies (the overlap
+  the bench line reports),
+* a prep error in batch k+1 degrades only that batch to host prep —
+  batch k's device verdict stands,
+* close() drains both stages without stranding futures,
+* 1-lane / no-mesh under the default "auto" mode keeps the exact
+  pre-pipeline launch schedule (the PR 8 single-lane equality doctrine),
+* staged inputs actually reach the lanes' verify_prepared seam, and
+* the --bls-pipeline mode wiring (cli ↔ BeaconNodeOptions ↔ pool).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from lodestar_tpu.chain.bls import BlsDeviceVerifierPool, VerifySignatureOpts
+from lodestar_tpu.chain.bls.pool import PIPELINE_MODES
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.testing.mesh import FakeLaneRig
+
+
+def _sets(n: int, tag: int = 0) -> list[SignatureSet]:
+    return [
+        SignatureSet(
+            pubkey=bytes([1, tag, i % 256]) + bytes(45),
+            message=bytes([2, tag, i % 256]) * 8 + bytes(8),
+            signature=bytes([3, tag, i % 256]) + bytes(93),
+        )
+        for i in range(n)
+    ]
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -- verdict equivalence -------------------------------------------------------
+
+
+def test_verdicts_identical_pipelined_vs_unpipelined():
+    """Seeded replay: the same job stream (some invalid) produces the
+    same per-job verdicts with the pipeline on and off. tag==13 marks a
+    set invalid, so the batch-then-retry road is exercised too."""
+
+    def verdict_fn(sets):
+        return all(s.message[1] != 13 for s in sets)
+
+    def replay(pipeline: str):
+        rng = random.Random(42)
+        rig = FakeLaneRig(2, with_prepared=True, with_sharded=False)
+
+        async def go():
+            pool = BlsDeviceVerifierPool(
+                mesh=rig.mesh,
+                scheduler_enabled=True,
+                pipeline=pipeline,
+                prep_fn=FakeLaneRig.prep_fn,
+            )
+            jobs = []
+            for i in range(24):
+                tag = 13 if rng.random() < 0.25 else i % 7
+                jobs.append(
+                    pool.verify_signature_sets(
+                        _sets(2, tag=tag),
+                        VerifySignatureOpts(
+                            batchable=rng.random() < 0.5,
+                            priority=PriorityClass.GOSSIP_ATTESTATION,
+                        ),
+                    )
+                )
+            verdicts = await asyncio.gather(*jobs)
+            await pool.close()
+            return verdicts
+
+        rig.verdict_fn = verdict_fn
+        return _run(go())
+
+    assert replay("off") == replay("on")
+
+
+# -- overlap -------------------------------------------------------------------
+
+
+def test_prep_of_next_batch_overlaps_verify_of_current():
+    """While lane L verifies batch k, the stage loop preps batch k+1 —
+    the overlap tracker must record concurrent prep+verify wall time."""
+    rig = FakeLaneRig(1, call_s=0.08, with_prepared=True, with_sharded=False)
+
+    def slow_prep(sets, lane_hint):
+        time.sleep(0.04)
+        return FakeLaneRig.prep_fn(sets, lane_hint)
+
+    async def go():
+        pool = BlsDeviceVerifierPool(
+            mesh=rig.mesh,
+            scheduler_enabled=True,
+            pipeline="on",
+            prep_fn=slow_prep,
+        )
+        jobs = []
+        for i in range(4):
+            jobs.append(
+                asyncio.ensure_future(
+                    pool.verify_signature_sets(
+                        _sets(1, tag=i), VerifySignatureOpts(batchable=False)
+                    )
+                )
+            )
+            await asyncio.sleep(0.02)  # arrive while the lane is busy
+        ok = await asyncio.gather(*jobs)
+        stats = pool.pipeline_stats()
+        await pool.close()
+        return ok, stats
+
+    ok, stats = _run(go())
+    assert all(ok)
+    assert stats["pipeline_enabled"] is True
+    assert stats["staged_packages"] >= 2
+    assert stats["overlap_ns"] > 0, stats
+    assert stats["overlap_occupancy_pct"] > 0.0
+
+
+def test_staged_inputs_reach_the_prepared_verify_seam():
+    rig = FakeLaneRig(1, with_prepared=True, with_sharded=False)
+
+    async def go():
+        pool = BlsDeviceVerifierPool(
+            mesh=rig.mesh,
+            scheduler_enabled=True,
+            pipeline="on",
+            prep_fn=FakeLaneRig.prep_fn,
+        )
+        ok = await pool.verify_signature_sets(
+            _sets(3), VerifySignatureOpts(batchable=False)
+        )
+        await pool.close()
+        return ok
+
+    assert _run(go()) is True
+    assert rig.prepared_calls, "staged inputs never reached verify_prepared_fn"
+
+
+# -- degradation ---------------------------------------------------------------
+
+
+def test_prep_error_in_batch_k1_degrades_only_that_batch(monkeypatch):
+    """Device prep forced on, the SECOND device-prep call injected to
+    fail: batch k preps on device and its device verdict stands; batch
+    k+1 degrades to host prep (fallback counted once) and still
+    verifies True. The degradation chain is build_device_inputs' own —
+    the pipeline only moved WHERE it runs."""
+    from lodestar_tpu.metrics import create_metrics
+    from lodestar_tpu.models import batch_verify as bv
+    from lodestar_tpu.ops import prep as dp
+
+    metrics = create_metrics()
+    bv.configure_device_prep(mode="on", metrics=metrics.bls_prep)
+    real = bv._prepare_sets_device_arrays
+    calls = {"n": 0}
+
+    def flaky(sets, size, fused=True):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected device prep fault in batch k+1")
+        return real(sets, size, fused=fused)
+
+    monkeypatch.setattr(bv, "_prepare_sets_device_arrays", flaky)
+    sets_k = bv.make_synthetic_sets(4, seed=61)
+    sets_k1 = bv.make_synthetic_sets(4, seed=62)
+
+    async def go():
+        pool = BlsDeviceVerifierPool(pipeline="on")
+        ok_k = await pool.verify_signature_sets(
+            sets_k, VerifySignatureOpts(batchable=False)
+        )
+        ok_k1 = await pool.verify_signature_sets(
+            sets_k1, VerifySignatureOpts(batchable=False)
+        )
+        await pool.close()
+        return ok_k, ok_k1
+
+    try:
+        ok_k, ok_k1 = _run(go())
+    finally:
+        dp.configure_launch_counter(None)
+        bv.configure_device_prep(mode="auto")
+        bv._prep_metrics = None
+        bv.consume_prep_info()
+    assert ok_k is True and ok_k1 is True
+    assert metrics.bls_prep.sets.labels("device")._value.get() == 4
+    assert metrics.bls_prep.sets.labels("host")._value.get() == 4
+    assert metrics.bls_prep.fallbacks._value.get() == 1
+
+
+# -- close ---------------------------------------------------------------------
+
+
+def test_close_drains_both_stages_without_stranding_futures():
+    rig = FakeLaneRig(1, call_s=0.2, with_prepared=True, with_sharded=False)
+
+    def slow_prep(sets, lane_hint):
+        time.sleep(0.1)
+        return FakeLaneRig.prep_fn(sets, lane_hint)
+
+    async def go():
+        pool = BlsDeviceVerifierPool(
+            mesh=rig.mesh,
+            scheduler_enabled=True,
+            pipeline="on",
+            prep_fn=slow_prep,
+        )
+        futures = [
+            asyncio.ensure_future(
+                pool.verify_signature_sets(
+                    _sets(1, tag=i), VerifySignatureOpts(batchable=False)
+                )
+            )
+            for i in range(6)
+        ]
+        await asyncio.sleep(0.05)  # one verifying, one staged, rest queued
+        await pool.close()
+        results = await asyncio.gather(*futures, return_exceptions=True)
+        return futures, results
+
+    futures, results = _run(go())
+    assert all(f.done() for f in futures)
+    for r in results:
+        assert isinstance(r, (bool, asyncio.CancelledError)), r
+
+
+# -- 1-lane schedule regression ------------------------------------------------
+
+
+def test_auto_single_lane_keeps_pre_pipeline_schedule():
+    """Default mode on a 1-lane / no-mesh pool: the pipeline must NOT
+    engage — launches stay serialized, the launch sequence matches an
+    explicit pipeline="off" pool job for job, and nothing is staged."""
+
+    def replay(pipeline: str):
+        rig = FakeLaneRig(1, call_s=0.01, with_sharded=False)
+
+        async def go():
+            pool = BlsDeviceVerifierPool(
+                mesh=rig.mesh, scheduler_enabled=True, pipeline=pipeline
+            )
+            assert pool.pipeline_stats()["pipeline_enabled"] is False
+            windows = []
+
+            orig = rig.verdict_fn
+
+            def timed(sets):
+                windows.append((time.monotonic(), len(sets)))
+                return orig(sets)
+
+            rig.verdict_fn = timed
+            for i in range(5):
+                assert await pool.verify_signature_sets(
+                    _sets(1, tag=i), VerifySignatureOpts(batchable=False)
+                )
+            stats = pool.pipeline_stats()
+            await pool.close()
+            return rig.calls, stats
+
+        return _run(go())
+
+    calls_auto, stats_auto = replay("auto")
+    calls_off, stats_off = replay("off")
+    assert calls_auto == calls_off  # identical lane/size launch sequence
+    assert stats_auto["staged_packages"] == 0 == stats_off["staged_packages"]
+    assert stats_auto["prep_ns"] == 0  # the prep stage never ran
+
+
+# -- mode wiring ---------------------------------------------------------------
+
+
+class TestPipelineModeWiring:
+    def test_pool_rejects_unknown_mode(self):
+        with pytest.raises(ValueError):
+            BlsDeviceVerifierPool(lambda sets: True, pipeline="bogus")
+
+    def test_cli_flag_accepts_exactly_the_pool_modes(self):
+        from lodestar_tpu import cli
+
+        ap = cli._build_parser()
+        for mode in PIPELINE_MODES:
+            args = ap.parse_args(["beacon", "--bls-pipeline", mode])
+            assert args.bls_pipeline == mode
+        with pytest.raises(SystemExit):
+            ap.parse_args(["beacon", "--bls-pipeline", "bogus"])
+
+    def test_node_options_validate_against_pool_modes(self):
+        from lodestar_tpu.node import BeaconNodeOptions
+
+        for mode in PIPELINE_MODES:
+            assert BeaconNodeOptions(bls_pipeline=mode).bls_pipeline == mode
+        with pytest.raises(ValueError):
+            BeaconNodeOptions(bls_pipeline="bogus")
+
+
+# -- review regressions --------------------------------------------------------
+
+
+def test_mesh_launch_drops_staged_inputs_on_cross_lane_retry():
+    """An error on a staged-inputs attempt may be input-bound, so the
+    cross-lane retry must re-prep inline (verify_fn) instead of feeding
+    every sibling the same poisoned inputs until the whole mesh wedges."""
+    from lodestar_tpu.chain.bls.mesh import (
+        MeshLane,
+        PreparedSets,
+        VerifierMesh,
+        mesh_launch,
+    )
+
+    calls = []
+
+    def l0_prepared(inputs):
+        calls.append("l0-prepared")
+        raise RuntimeError("poisoned staged inputs")
+
+    def l0_plain(sets):
+        calls.append("l0-plain")
+        raise RuntimeError("unreachable on this path")
+
+    def l1_prepared(inputs):
+        calls.append("l1-prepared")
+        return True
+
+    def l1_plain(sets):
+        calls.append("l1-plain")
+        return True
+
+    lanes = [
+        MeshLane(0, l0_plain, verify_prepared_fn=l0_prepared),
+        MeshLane(1, l1_plain, verify_prepared_fn=l1_prepared),
+    ]
+    mesh = VerifierMesh(lanes)
+    ok, served = mesh_launch(
+        mesh, _sets(1), prefer=lanes[0], prepared=PreparedSets(inputs=("staged",))
+    )
+    assert ok is True and served is lanes[1]
+    assert calls == ["l0-prepared", "l1-plain"]
+
+
+def test_dead_dispatch_stage_restarts_on_next_submit():
+    """A dead verify dispatcher (stage 2) with a live staging loop must
+    self-heal on the next submit instead of filling the 1-deep queue
+    and hanging every later verify."""
+    rig = FakeLaneRig(1, with_prepared=True, with_sharded=False)
+
+    async def go():
+        pool = BlsDeviceVerifierPool(
+            mesh=rig.mesh,
+            scheduler_enabled=True,
+            pipeline="on",
+            prep_fn=FakeLaneRig.prep_fn,
+        )
+        assert await pool.verify_signature_sets(
+            _sets(1), VerifySignatureOpts(batchable=False)
+        )
+        pool._verify_runner.cancel()
+        await asyncio.sleep(0)  # let the cancellation land
+        assert pool._verify_runner.done()
+        ok = await pool.verify_signature_sets(
+            _sets(1, tag=1), VerifySignatureOpts(batchable=False)
+        )
+        await pool.close()
+        return ok
+
+    assert _run(go()) is True

@@ -208,3 +208,125 @@ class TestWideReduction:
         m = prep.mont_from_wide(lo, hi)
         got = [fp.int_from_limbs(x) for x in np.asarray(fp.from_mont(m))]
         assert got == [v % F.P for v in wides]
+
+
+class TestFusedPrepSchedule:
+    """Round-10 acceptance: the fused dispatch chains. The launch budget
+    is asserted against the dispatch-site counter (the same seam the
+    `lodestar_bls_prep_launches_total` metric increments), and the fused
+    programs are pinned bit-exact against both the pre-fusion per-leg
+    schedule and the RFC 9380 known-answer vectors."""
+
+    def _parse_points(self, n=8):
+        pk_raw = np.stack(
+            [np.frombuffer(serdes.g1_to_bytes(G1_GEN), np.uint8)] * n
+        )
+        sig_raw = np.stack(
+            [np.frombuffer(serdes.g2_to_bytes(G2_GEN), np.uint8)] * n
+        )
+        pk_limbs, pk_sign, pk_ok = prep.parse_g1_compressed(pk_raw)
+        sig_limbs, sig_sign, sig_ok = prep.parse_g2_compressed(sig_raw)
+        assert pk_ok.all() and sig_ok.all()
+        return pk_limbs, pk_sign, sig_limbs, sig_sign
+
+    def test_launch_budget_independent_of_batch_size(self):
+        """`prepare_sets_device` costs exactly FUSED_PREP_LAUNCHES
+        dispatches per batch — independent of the number of sets and of
+        the chain lengths inside the programs (well under the <= ~12
+        acceptance budget; the pre-fusion schedule paid one launch per
+        leg and, on dispatch-bound backends, one per squaring)."""
+        from lodestar_tpu.models import batch_verify as bv
+
+        assert prep.FUSED_PREP_LAUNCHES <= 12
+        for n in (2, 5, 8):
+            sets = bv.make_synthetic_sets(n, seed=n)
+            base = prep.prep_launches_total()
+            assert bv.prepare_sets_device(sets) is not None
+            assert prep.prep_launches_total() - base == prep.FUSED_PREP_LAUNCHES
+
+    def test_rejection_batches_stay_on_budget(self):
+        """Invalid batches keep the same fixed dispatch budget: a
+        non-subgroup point is decided ON DEVICE (full schedule), a
+        wrong-length encoding is a host-parse reject (zero dispatches)."""
+        from lodestar_tpu.crypto.bls.api import SignatureSet
+        from lodestar_tpu.models import batch_verify as bv
+
+        sets = bv.make_synthetic_sets(3, seed=17)
+        r = rng(31)
+        off = _g1_offsubgroup_point(r)
+        bad = list(sets)
+        bad[1] = SignatureSet(
+            pubkey=serdes.g1_to_bytes(off),
+            message=bad[1].message,
+            signature=bad[1].signature,
+        )
+        base = prep.prep_launches_total()
+        assert bv.prepare_sets_device(bad) is None
+        assert prep.prep_launches_total() - base == prep.FUSED_PREP_LAUNCHES
+
+        short = list(sets)
+        short[0] = SignatureSet(
+            pubkey=short[0].pubkey, message=short[0].message, signature=b"\x00" * 95
+        )
+        base = prep.prep_launches_total()
+        assert bv.prepare_sets_device(short) is None
+        assert prep.prep_launches_total() - base == 0
+
+    def test_fused_matches_unfused_bit_exact(self):
+        """The fused stages produce limb-identical outputs to the
+        pre-fusion per-leg schedule (both device paths), at
+        FUSED_PREP_LAUNCHES vs UNFUSED_PREP_LAUNCHES dispatches."""
+        from lodestar_tpu.models import batch_verify as bv
+
+        sets = bv.make_synthetic_sets(5, seed=23)
+        base = prep.prep_launches_total()
+        fused = bv.prepare_sets_device(sets, fused=True)
+        assert prep.prep_launches_total() - base == prep.FUSED_PREP_LAUNCHES
+        base = prep.prep_launches_total()
+        unfused = bv.prepare_sets_device(sets, fused=False)
+        assert prep.prep_launches_total() - base == prep.UNFUSED_PREP_LAUNCHES
+        assert fused is not None and unfused is not None
+        for leg_f, leg_u in zip(fused, unfused):
+            for coord in range(2):
+                ff = np.asarray(fp.from_mont(leg_f[coord]))
+                uu = np.asarray(fp.from_mont(leg_u[coord]))
+                assert (ff == uu).all()
+
+    def test_rfc9380_g2_known_answer_through_fused_stage(self):
+        """RFC 9380 J.10.1 bit-exactness of the FUSED field stage: the
+        hash leg of `prepare_arrays_fused` (one shared sqrt chain for
+        the G2 root and all SSWU candidates) reproduces the vectors."""
+        msgs = [v[0] for v in RFC9380_G2_RO_VECTORS]
+        padded = msgs + [msgs[0]] * (8 - len(msgs))
+        lo, hi = prep.hash_to_field_limbs(padded, RFC9380_G2_DST)
+        pk_limbs, pk_sign, sig_limbs, sig_sign = self._parse_points(8)
+        pk, pk_ok, sig, sig_ok, (hx, hy) = prep.prepare_arrays_fused(
+            pk_limbs, pk_sign, sig_limbs, sig_sign, lo, hi
+        )
+        assert np.asarray(pk_ok).all() and np.asarray(sig_ok).all()
+        gx = tw.fp2_to_ints(np.asarray(hx))
+        gy = tw.fp2_to_ints(np.asarray(hy))
+        for i, (_msg, px0, px1, py0, py1) in enumerate(RFC9380_G2_RO_VECTORS):
+            assert "%096x" % gx[i][0] == px0
+            assert "%096x" % gx[i][1] == px1
+            assert "%096x" % gy[i][0] == py0
+            assert "%096x" % gy[i][1] == py1
+
+    def test_launch_counter_metric_increments_at_dispatch_site(self):
+        """Satellite: `lodestar_bls_prep_launches_total` counts the same
+        dispatches the process-local counter does."""
+        from lodestar_tpu.metrics import create_metrics
+        from lodestar_tpu.models import batch_verify as bv
+
+        metrics = create_metrics()
+        prev = bv.configure_device_prep(metrics=metrics.bls_prep)
+        try:
+            sets = bv.make_synthetic_sets(4, seed=29)
+            assert bv.prepare_sets_device(sets) is not None
+            assert (
+                metrics.bls_prep.launches._value.get() == prep.FUSED_PREP_LAUNCHES
+            )
+        finally:
+            prep.configure_launch_counter(None)
+            bv.configure_device_prep(mode=prev)
+            bv._prep_metrics = None

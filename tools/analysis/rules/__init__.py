@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from .blocking_under_lock import BlockingUnderLockRule
 from .fail_closed import FailClosedVerdictsRule
+from .fault_wiring import FaultWiringRule
 from .lock_discipline import LockDisciplineRule
 from .monotonic import MonotonicDurationsRule
 from .rest_wiring import RestRouteWiringRule
@@ -18,6 +19,7 @@ ALL_RULES = (
     MonotonicDurationsRule(),
     MetricsCliWiringRule(),
     RestRouteWiringRule(),
+    FaultWiringRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -1,0 +1,198 @@
+"""fault-wiring: `FaultKind` registry ↔ delivery code ↔ consumers, both
+directions — the cross-file sibling of the REST-route rule (same
+doctrine: a fault that schedules but never fires, or a consumer naming
+a fault that doesn't exist, silently does nothing exactly when a chaos
+test depends on it).
+
+Project-scoped over ``lodestar_tpu/testing/faults.py`` (the registry)
+plus every ``FaultKind`` consumer under ``lodestar_tpu/`` and
+``tests/`` (``tests/analysis/fixtures`` excluded — those trees are
+deliberately broken):
+
+1. **registry → delivery**: every ``FaultKind`` member must be
+   referenced by name somewhere in ``faults.py`` OUTSIDE the enum class
+   body — the delivery seams (``_pre_call`` / ``wrap_backend`` /
+   ``_BACKEND_KINDS``). A member with no delivery branch falls through
+   ``_next_fault``'s rule match and then injects NOTHING: the chaos
+   test believes it stormed the system and proved an invariant the
+   fault never exercised.
+2. **consumers → registry**: every ``FaultKind.X`` attribute access and
+   every ``FaultKind("...")`` literal construction in the scanned trees
+   must name a declared member/value — a typo'd kind is an
+   AttributeError/ValueError only at the moment the chaos test runs.
+3. **registry hygiene**: two members sharing one string value make
+   ``FaultKind("...")`` lookups ambiguous aliases — flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..core import Finding, Rule
+
+REGISTRY_REL = Path("lodestar_tpu") / "testing" / "faults.py"
+ENUM_CLASS = "FaultKind"
+#: directories scanned for consumers (relative to repo_root); the
+#: analysis fixture trees are deliberately-broken code and excluded
+SCAN_DIRS = ("lodestar_tpu", "tests")
+EXCLUDE_PARTS = {"fixtures", "__pycache__"}
+
+
+def _enum_members(tree: ast.Module) -> tuple[ast.ClassDef | None, dict[str, tuple[str, int]]]:
+    """(class node, name -> (value, line)) for the FaultKind enum."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == ENUM_CLASS:
+            members: dict[str, tuple[str, int]] = {}
+            for stmt in node.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                target = stmt.targets[0]
+                if not isinstance(target, ast.Name) or target.id.startswith("_"):
+                    continue
+                value = stmt.value
+                val = value.value if isinstance(value, ast.Constant) else None
+                members[target.id] = (val, stmt.lineno)
+            return node, members
+    return None, {}
+
+
+def _kind_refs(tree: ast.Module) -> list[tuple[str, int]]:
+    """(member_name, line) for every `FaultKind.X` / `<mod>.FaultKind.X`
+    attribute access in the tree."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == ENUM_CLASS:
+            out.append((node.attr, node.lineno))
+        elif isinstance(base, ast.Attribute) and base.attr == ENUM_CLASS:
+            out.append((node.attr, node.lineno))
+    return out
+
+
+def _kind_calls(tree: ast.Module) -> list[tuple[str, int]]:
+    """(value, line) for every `FaultKind("...")` literal construction."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or len(node.args) != 1:
+            continue
+        fn = node.func
+        named = (isinstance(fn, ast.Name) and fn.id == ENUM_CLASS) or (
+            isinstance(fn, ast.Attribute) and fn.attr == ENUM_CLASS
+        )
+        arg = node.args[0]
+        if named and isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.lineno))
+    return out
+
+
+def _outside_class(refs: list[tuple[str, int]], cls: ast.ClassDef) -> set[str]:
+    end = getattr(cls, "end_lineno", cls.lineno)
+    return {name for name, line in refs if not (cls.lineno <= line <= end)}
+
+
+class FaultWiringRule(Rule):
+    name = "fault-wiring"
+    description = (
+        "FaultKind registry ↔ delivery seams ↔ consumers are wired both "
+        "ways (every member has a delivery branch; every FaultKind.X / "
+        'FaultKind("...") names a real member)'
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        findings: list[Finding] = []
+        registry_path = repo_root / REGISTRY_REL
+        if not registry_path.is_file():
+            return findings
+        tree = ast.parse(
+            registry_path.read_text(encoding="utf-8"), filename=str(registry_path)
+        )
+        cls, members = _enum_members(tree)
+        if cls is None or not members:
+            findings.append(
+                Finding(
+                    self.name, str(registry_path), 1,
+                    f"class {ENUM_CLASS} not found — the fault-wiring "
+                    "anchors moved; update the rule",
+                )
+            )
+            return findings
+
+        # registry hygiene: duplicate string values alias each other
+        by_value: dict[str, str] = {}
+        for name, (val, line) in sorted(members.items(), key=lambda kv: kv[1][1]):
+            if val in by_value:
+                findings.append(
+                    Finding(
+                        self.name, str(registry_path), line,
+                        f"{ENUM_CLASS}.{name} reuses value {val!r} of "
+                        f"{ENUM_CLASS}.{by_value[val]} — aliased members make "
+                        f'{ENUM_CLASS}("{val}") ambiguous',
+                    )
+                )
+            else:
+                by_value[val] = name
+
+        # 1. registry -> delivery
+        delivered = _outside_class(_kind_refs(tree), cls)
+        for name in sorted(set(members) - delivered):
+            findings.append(
+                Finding(
+                    self.name, str(registry_path), members[name][1],
+                    f"{ENUM_CLASS}.{name} is declared but never referenced by "
+                    "a delivery seam in this module — the fault schedules "
+                    "and then injects nothing",
+                )
+            )
+
+        # 2. consumers -> registry
+        values = {val for val, _name in by_value.items()}
+        for path in self._consumer_files(repo_root, registry_path):
+            try:
+                text = path.read_text(encoding="utf-8")
+            except OSError:
+                continue
+            if ENUM_CLASS not in text:
+                continue
+            try:
+                consumer = ast.parse(text, filename=str(path))
+            except SyntaxError:
+                continue
+            for name, line in _kind_refs(consumer):
+                if name not in members:
+                    findings.append(
+                        Finding(
+                            self.name, str(path), line,
+                            f"{ENUM_CLASS}.{name} names no declared member — "
+                            "AttributeError the moment this fault is scheduled",
+                        )
+                    )
+            for val, line in _kind_calls(consumer):
+                if val not in values:
+                    findings.append(
+                        Finding(
+                            self.name, str(path), line,
+                            f'{ENUM_CLASS}("{val}") matches no member value — '
+                            "ValueError the moment this fault is scheduled",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _consumer_files(repo_root: Path, registry_path: Path):
+        for rel in SCAN_DIRS:
+            base = repo_root / rel
+            if not base.is_dir():
+                continue
+            for path in sorted(base.rglob("*.py")):
+                # exclusion is RELATIVE to the scanned tree: a repo that
+                # itself lives under a directory named "fixtures" (this
+                # rule's own test fixtures) must still be scanned
+                if EXCLUDE_PARTS & set(path.relative_to(base).parts):
+                    continue
+                if path.resolve() == registry_path.resolve():
+                    continue
+                yield path

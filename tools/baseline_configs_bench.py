@@ -38,9 +38,9 @@ QUICK = "--quick" in sys.argv
 REFERENCE_SIGS_PER_SEC_PER_CORE = 2200.0  # blst envelope (bench.py)
 
 
-def _line(metric, value, unit, vs):
+def _line(metric, value, unit, vs, digits=1):
     print(json.dumps({
-        "metric": metric, "value": round(value, 1), "unit": unit,
+        "metric": metric, "value": round(value, digits), "unit": unit,
         "vs_baseline": round(vs, 2),
     }), flush=True)
 
@@ -262,6 +262,101 @@ def device_prep_rate():
     rate = n / dt
     _line("device_prep_sets_per_sec", rate, "sets/s",
           rate / REFERENCE_SIGS_PER_SEC_PER_CORE)
+
+
+def prep_launch_fusion():
+    """Launch count before/after fusing the prep dispatch chains: the
+    same batch through the pre-fusion one-launch-per-leg schedule and
+    the fused stages, counted at ops/prep.py's dispatch seam (the same
+    number `lodestar_bls_prep_launches_total` increments)."""
+    from lodestar_tpu.models import batch_verify as bv
+    from lodestar_tpu.ops import prep as dp
+
+    n = 32
+    sets = bv.make_synthetic_sets(n, seed=47)
+    per_set = {}
+    for fused, name in (
+        (False, "prep_launches_per_set_unfused"),
+        (True, "prep_launches_per_set"),
+    ):
+        if bv.prepare_sets_device(sets, fused=fused) is None:  # warm compiles
+            raise RuntimeError("prep rejected valid sets")
+        base = dp.prep_launches_total()
+        if bv.prepare_sets_device(sets, fused=fused) is None:
+            raise RuntimeError("prep rejected valid sets")
+        per_set[name] = (dp.prep_launches_total() - base) / n
+    _line(
+        "prep_launches_per_set_unfused", per_set["prep_launches_per_set_unfused"],
+        "launches/set", 1.0, digits=4,
+    )
+    _line(
+        "prep_launches_per_set", per_set["prep_launches_per_set"],
+        "launches/set",
+        per_set["prep_launches_per_set"] / per_set["prep_launches_per_set_unfused"],
+        digits=4,
+    )
+
+
+def config2_gossip_replay_pipelined():
+    """Config-2 gossip replay with the prep→verify pipeline ON (1-lane
+    interleave on this container) and device prep on — the line to read
+    against gossip_replay_sigs_per_sec_device_prep — plus the measured
+    fraction of verify wall time with a prep stage in flight."""
+    import asyncio
+
+    from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
+    from lodestar_tpu.chain.bls.pool import BlsDeviceVerifierPool
+    from lodestar_tpu.models.batch_verify import configure_device_prep, make_synthetic_sets
+
+    n = 1024 if QUICK else 4096
+    sets = make_synthetic_sets(n, seed=31)
+    opts = VerifySignatureOpts(batchable=True)
+
+    async def run():
+        pool = BlsDeviceVerifierPool(pipeline="on")
+        jobs = [sets[i : i + 32] for i in range(0, n, 32)]
+
+        async def replay():
+            # gossip is a STREAM: jobs arrive over time, so packages
+            # form sequentially and prep of package k+1 runs while
+            # package k verifies (an all-at-once gather coalesces the
+            # whole replay into two giant packages whose preps both
+            # finish before the first verify — nothing left to overlap)
+            tasks = []
+            for j in jobs:
+                tasks.append(
+                    asyncio.ensure_future(pool.verify_signature_sets(j, opts))
+                )
+                await asyncio.sleep(0.01)
+            return await asyncio.gather(*tasks)
+
+        await replay()  # warm the compiled programs
+        base = pool.pipeline_stats()
+        t0 = time.perf_counter()
+        oks = await replay()
+        dt = time.perf_counter() - t0
+        if not all(oks):
+            raise RuntimeError("pipelined gossip replay batch failed")
+        stats = pool.pipeline_stats()
+        await pool.close()
+        if not stats["pipeline_enabled"] or stats["staged_packages"] == 0:
+            raise RuntimeError(
+                "pipeline never engaged — refusing to report a pipelined "
+                "number for an unpipelined run"
+            )
+        overlap = stats["overlap_ns"] - base["overlap_ns"]
+        verify = stats["verify_ns"] - base["verify_ns"]
+        return n / dt, (100.0 * overlap / verify) if verify else 0.0
+
+    prev = configure_device_prep(mode="on")
+    try:
+        rate, overlap_pct = asyncio.run(run())
+    finally:
+        configure_device_prep(mode=prev)
+    _line("pipelined_gossip_replay_sigs_per_sec", rate, "sigs/s",
+          rate / REFERENCE_SIGS_PER_SEC_PER_CORE)
+    _line("prep_verify_overlap_occupancy_pct", overlap_pct, "pct",
+          overlap_pct / 100.0)
 
 
 def state_htr_rate():
@@ -553,12 +648,14 @@ def two_tenant_fairness_replay():
 def main():
     host_prep_rate()
     device_prep_rate()
+    prep_launch_fusion()
     config4_merkle_1m()
     state_htr_rate()
     epoch_htr_replay()
     config5_backfill_window()
     config2_gossip_replay()
     config2_gossip_replay(device_prep=True)
+    config2_gossip_replay_pipelined()
     config3_sync_committee_aggregate()
     mesh_scaling()
     two_tenant_fairness_replay()

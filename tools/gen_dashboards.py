@@ -127,6 +127,26 @@ def bls_pool():
             ],
             unit="ops", x=0, y=24, pid=7,
         ),
+        panel(
+            # launches-per-set: the fused schedule costs a fixed launch
+            # budget per batch, so this quotient falls with batch size
+            # and spikes if a regression re-serializes the chains. The
+            # numerator is the plain dispatch counter (it counts per-leg
+            # and hash-to-G2 dispatches too); the strict per-batch
+            # budget invariant lives in the tests. BOTH operands wrapped
+            # in sum(): a labeled-vs-aggregated vector match is empty
+            # and renders the panel permanently blank (the PR 7 round-5
+            # launches/flush lesson).
+            "Prep launches per set (device layer)",
+            [
+                (
+                    "sum(rate(lodestar_bls_prep_launches_total[5m])) / "
+                    "sum(rate(lodestar_bls_prep_sets_total{layer=\"device\"}[5m]))",
+                    "launches/set",
+                ),
+            ],
+            unit="ops", x=12, y=24, pid=8,
+        ),
     ]
     return dashboard("lodestar-bls-pool", "Lodestar TPU - BLS verifier pool", ps, ["lodestar", "bls"])
 
