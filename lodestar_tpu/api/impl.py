@@ -1106,6 +1106,21 @@ class BeaconApiImpl:
         traces = tracing.get_tracer().recent_traces(count)
         return {"data": [t.to_dict() for t in traces]}
 
+    def get_debug_launches(self, count: int = 64) -> dict:
+        """The device launch ledger (`lodestar_tpu/telemetry.py`): the
+        trailing `count` dispatches at the counted launch seams, plus
+        the cumulative totals — a slow slot's launches by name without
+        waiting for a Prometheus scrape."""
+        from lodestar_tpu import telemetry
+
+        return {
+            "data": {
+                "mode_active": telemetry.launch_telemetry_active(),
+                "totals": telemetry.launch_totals(),
+                "launches": telemetry.launch_ledger(max(0, count)),
+            }
+        }
+
     def get_fork_choice_nodes(self) -> dict:
         fc = self.chain.fork_choice.proto_array
         return {

@@ -29,8 +29,10 @@ amortize the collective launch.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Sequence
 
+from lodestar_tpu import telemetry
 from lodestar_tpu.scheduler import OccupancyTracker
 
 __all__ = [
@@ -237,14 +239,30 @@ def mesh_launch(
     while True:
         tried.append(current)
         try:
+            # launch telemetry at the lane seam: wall time of the whole
+            # verify launch this lane serves (staged-inputs verify, or
+            # the full re-prep + verify chain), labeled with the lane so
+            # a mesh slot's launches name their chips. Size class is the
+            # pow-2 bucket of the set count — the verify programs' own
+            # compile-cache bucketing.
+            t0 = time.perf_counter() if telemetry.launch_telemetry_active() else 0.0
+            dispatched = True
             with current.occupancy.launch():
                 use_staged = prepared is not None and prepared.error is None
                 if use_staged and prepared.inputs is None:
                     ok = False  # prep rejected the batch: verdict final
+                    dispatched = False  # no backend call — not a launch
                 elif use_staged and current.verify_prepared_fn is not None:
                     ok = bool(current.verify_prepared_fn(prepared.inputs))
                 else:
                     ok = bool(current.verify_fn(sets))
+            if t0 and dispatched:
+                telemetry.record_launch(
+                    "bls_lane_verify",
+                    telemetry.size_class_of(len(sets)),
+                    time.perf_counter() - t0,
+                    lane=current.label,
+                )
         except Exception:
             # an error on a staged-inputs attempt may be input-bound
             # (arrays committed to the sick die, a malformed staging) —

@@ -265,6 +265,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         mesh_mode: str | None = None,
         pipeline: str = "auto",
         prep_fn: Callable | None = None,
+        pipeline_metrics=None,
     ) -> None:
         explicit_fn = verify_fn is not None
         if verify_fn is None:
@@ -334,6 +335,23 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         self._verify_runner: asyncio.Task | None = None  # guarded by: event-loop (single-threaded)
         self._overlap = _OverlapTracker()
         self._staged_packages = 0  # guarded by: advisory-only (monotonic count, prep threads under the GIL)
+        if pipeline_metrics is not None:
+            # scrape-time evaluation (the occupancy-gauge pattern): the
+            # previously process-trapped pipeline_stats() numbers become
+            # live lodestar_bls_pipeline_* gauges — overlap occupancy,
+            # staged packages, and the prep/verify busy accumulators
+            pipeline_metrics.overlap_occupancy_pct.set_function(
+                lambda: self.pipeline_stats()["overlap_occupancy_pct"]
+            )
+            pipeline_metrics.staged_packages.set_function(
+                lambda: self._staged_packages
+            )
+            pipeline_metrics.prep_seconds.set_function(
+                lambda: self._overlap.snapshot()["prep_ns"] / 1e9
+            )
+            pipeline_metrics.verify_seconds.set_function(
+                lambda: self._overlap.snapshot()["verify_ns"] / 1e9
+            )
 
         self.scheduler_enabled = scheduler_enabled
         self._sched_metrics = sched_metrics
@@ -973,12 +991,18 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 self.metrics["batch_retries"] += 1
                 if traced:
                     self._trace_unit_prep(jobs, staged, t0)
-                    self._trace_launch(jobs, t0, len(all_sets), "batch_error", lane.label)
+                    self._trace_launch(
+                        jobs, t0, len(all_sets), "batch_error", lane.label,
+                        lane=str(lane.index),
+                    )
                 retries.extend(jobs)
                 continue
             if traced:
                 self._trace_unit_prep(jobs, staged, t0)
-                self._trace_launch(jobs, t0, len(all_sets), "batch", served.label)
+                self._trace_launch(
+                    jobs, t0, len(all_sets), "batch", served.label,
+                    lane=str(served.index),
+                )
             if ok:
                 self.metrics["batch_sigs_success"] += len(all_sets)
                 for j in jobs:
@@ -994,12 +1018,18 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 ok, served = self._launch_sets(lane, sets_, prepared=staged)
                 if traced:
                     self._trace_unit_prep([j], staged, t0)
-                    self._trace_launch([j], t0, len(sets_), "single", served.label)
+                    self._trace_launch(
+                        [j], t0, len(sets_), "single", served.label,
+                        lane=str(served.index),
+                    )
                 self._resolve(j, ok)
             except Exception as e:
                 if traced:
                     self._trace_unit_prep([j], staged, t0)
-                    self._trace_launch([j], t0, len(sets_), "single_error", lane.label)
+                    self._trace_launch(
+                        [j], t0, len(sets_), "single_error", lane.label,
+                        lane=str(lane.index),
+                    )
                 if not j.future.done():
                     j.future.get_loop().call_soon_threadsafe(self._reject, j, e)
 
@@ -1075,6 +1105,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                 self._trace_launch(
                     package, t0, len(all_sets), "sharded_error",
                     ",".join(lane.label for lane in lanes),
+                    lane=",".join(str(lane.index) for lane in lanes),
                 )
             fallback = min(lanes, key=lambda l: l.occupancy.occupancy())
             self._release_unused(lanes, fallback, held, package)
@@ -1084,6 +1115,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             self._trace_launch(
                 package, t0, len(all_sets), "sharded",
                 ",".join(lane.label for lane in lanes),
+                lane=",".join(str(lane.index) for lane in lanes),
             )
         if ok:
             self.metrics["batch_sigs_success"] += len(all_sets)
@@ -1143,15 +1175,28 @@ class BlsDeviceVerifierPool(IBlsVerifier):
 
     @staticmethod
     def _trace_launch(
-        jobs: list[_Job], start_ns: int, n_sets: int, mode: str, device: str = "dev0"
+        jobs: list[_Job],
+        start_ns: int,
+        n_sets: int,
+        mode: str,
+        device: str = "dev0",
+        lane: str | None = None,
     ) -> None:
         """Per-traced-job device-launch span; a batch covering jobs from
         several traces lands one identically-timed span in each. A
         batchable job verified in the single pass got there because its
         batch failed — that's the reference's batch-then-retry path, so
         it's labeled bls_batch_retry to keep the decomposition visible.
-        The serving lane rides along as the `device` attribute."""
+        The serving lane rides along as the `device` attribute (plus the
+        `lane` index when known), and is ALSO stamped onto the job's
+        trace parent — for chain imports that is the `bls_verify` span,
+        so a Chrome-trace export of a mesh slot names its chips at the
+        top level (a job served across several launches keeps the last
+        serving lane, the one that produced its verdict)."""
         end_ns = time.monotonic_ns()
+        attrs = {"sets": n_sets, "mode": mode, "device": device}
+        if lane is not None:
+            attrs["lane"] = lane
         for j in jobs:
             if j.trace_parent is not None:
                 retried = j.batchable and mode.startswith("single")
@@ -1160,8 +1205,9 @@ class BlsDeviceVerifierPool(IBlsVerifier):
                     "bls_batch_retry" if retried else "bls_device_launch",
                     start_ns,
                     end_ns,
-                    {"sets": n_sets, "mode": mode, "device": device},
+                    attrs,
                 )
+                j.trace_parent.set(device=device, **({"lane": lane} if lane is not None else {}))
 
     def _resolve(self, job: _Job, result: bool) -> None:
         if not job.future.done():

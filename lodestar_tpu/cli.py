@@ -40,6 +40,18 @@ def _add_tracing_args(sp) -> None:
         "--tracing-export-max-age-sec", type=float, default=None,
         help="prune exported trace files older than this many seconds",
     )
+    sp.add_argument(
+        # literal copy of telemetry.TELEMETRY_MODES (argparse-import
+        # doctrine: BeaconNodeOptions re-validates against the canonical
+        # tuple post-parse, so a drifted copy fails loudly there)
+        "--launch-telemetry", choices=["auto", "on", "off"], default="auto",
+        help="record per-dispatch device launch telemetry (wall time, "
+        "program, size class, first-call compile detection) at the "
+        "counted dispatch seams: auto = once the node's metric sink is "
+        "installed, on = always (ledger even without metrics), off = "
+        "disabled. Surfaced as lodestar_device_launch_* metrics, "
+        "GET /eth/v0/debug/launches, and slow-slot dumps.",
+    )
 
 
 def _add_scheduler_args(sp) -> None:
@@ -364,6 +376,7 @@ async def _run_dev(args) -> int:
             htr_device=args.htr_device,
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,
+            launch_telemetry=args.launch_telemetry,
         ),
         p=p,
         time_fn=lambda: now[0],
@@ -533,6 +546,7 @@ async def _run_beacon(args) -> int:
             htr_device=args.htr_device,
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,
+            launch_telemetry=args.launch_telemetry,
         ),
         p=p,
         db=db,

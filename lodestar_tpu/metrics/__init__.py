@@ -29,6 +29,8 @@ __all__ = [
     "RegistryMetricCreator",
     "BeaconMetrics",
     "BlsPrepMetrics",
+    "BlsPipelineMetrics",
+    "DeviceLaunchMetrics",
     "TraceMetrics",
     "SchedulerMetrics",
     "ResilienceMetrics",
@@ -379,6 +381,37 @@ class BlsPrepMetrics:
 
 
 @dataclass
+class BlsPipelineMetrics:
+    """lodestar_bls_pipeline_* — the prep→verify double buffer
+    (`chain/bls/pool.py` `_OverlapTracker`/`pipeline_stats()`): live
+    gauges over the pool's pipeline accounting, evaluated at scrape
+    time via `set_function` (the same pattern as the occupancy gauges)
+    so the previously process-trapped `pipeline_stats()` numbers are
+    dashboard-readable during a run, not only from bench harnesses."""
+
+    overlap_occupancy_pct: Gauge  # % of verify busy time with a prep stage in flight
+    staged_packages: Gauge  # packages staged through the double buffer (cumulative)
+    prep_seconds: Gauge  # cumulative prep-stage busy seconds
+    verify_seconds: Gauge  # cumulative verify-stage busy seconds
+
+
+@dataclass
+class DeviceLaunchMetrics:
+    """lodestar_device_launch_* / lodestar_device_compile_* — the launch
+    telemetry layer (`lodestar_tpu/telemetry.py`): per-dispatch wall
+    time by program and size class at the counted dispatch seams
+    (ops/prep `_dispatch`, ssz/device_htr `_device_level`, mesh lane
+    launches, the batch-verify jit-cache seams), plus first-call
+    compile-detection counters — the compile-vs-dispatch decomposition
+    the hardware measurement campaign reads."""
+
+    launch_seconds: Histogram  # dispatch wall time, labeled by program + size_class
+    compile_seconds: Counter  # wall time of first-call (trace+compile) dispatches
+    compile_hits: Counter  # dispatches whose (program, size_class) was already compiled
+    compile_misses: Counter  # first-call dispatches per (program, size_class) key
+
+
+@dataclass
 class SszHtrMetrics:
     """lodestar_ssz_htr_* — device hashTreeRoot (`ssz/device_htr.py`
     collector, `state_transition/htr.py` tracker): dirty-subtree
@@ -411,6 +444,8 @@ class BeaconMetrics:
     creator: RegistryMetricCreator
     bls_pool: BlsPoolMetrics
     bls_prep: "BlsPrepMetrics"
+    bls_pipeline: "BlsPipelineMetrics"
+    device_launch: "DeviceLaunchMetrics"
     ssz_htr: "SszHtrMetrics"
     state_transition: StateTransitionMetrics
     gossip: GossipMetrics
@@ -509,6 +544,54 @@ def create_metrics() -> BeaconMetrics:
             "ops/prep.py launch seam: fused-stage, per-leg, and "
             "hash-to-G2 dispatches all count; the per-batch budget "
             "invariant is asserted in tests against the same seam)",
+        ),
+    )
+    bls_pipeline = BlsPipelineMetrics(
+        overlap_occupancy_pct=c.gauge(
+            "lodestar_bls_pipeline_overlap_occupancy_pct",
+            "Percent of verify-stage busy time with a prep stage in flight "
+            "(the pool's pipeline_stats overlap accounting, scrape-time)",
+        ),
+        staged_packages=c.gauge(
+            "lodestar_bls_pipeline_staged_packages",
+            "Packages staged through the prep→verify double buffer "
+            "(cumulative; 0 = the pipeline never engaged)",
+        ),
+        prep_seconds=c.gauge(
+            "lodestar_bls_pipeline_prep_seconds_total",
+            "Cumulative wall seconds some prep stage was in flight",
+        ),
+        verify_seconds=c.gauge(
+            "lodestar_bls_pipeline_verify_seconds_total",
+            "Cumulative wall seconds some verify stage was in flight",
+        ),
+    )
+    device_launch = DeviceLaunchMetrics(
+        launch_seconds=c.histogram(
+            "lodestar_device_launch_seconds",
+            "Device dispatch wall time at the counted launch seams, by "
+            "program and pow-2 size class (host-observed: includes device "
+            "execution on synchronous backends and trace+compile on the "
+            "first call per class)",
+            (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120),
+            ["program", "size_class"],
+        ),
+        compile_seconds=c.counter(
+            "lodestar_device_compile_seconds_total",
+            "Wall seconds spent in first-call-per-(program,size_class) "
+            "dispatches — the trace+compile (or persistent-cache load) tax",
+        ),
+        compile_hits=c.counter(
+            "lodestar_device_compile_hits_total",
+            "Dispatches whose (program, size_class) executable was already "
+            "compiled in this process",
+            ["program"],
+        ),
+        compile_misses=c.counter(
+            "lodestar_device_compile_misses_total",
+            "First-call dispatches per (program, size_class) — each paid "
+            "trace+compile or a persistent-cache load",
+            ["program"],
         ),
     )
     ssz_htr = SszHtrMetrics(
@@ -968,6 +1051,8 @@ def create_metrics() -> BeaconMetrics:
         creator=c,
         bls_pool=bls,
         bls_prep=bls_prep,
+        bls_pipeline=bls_pipeline,
+        device_launch=device_launch,
         ssz_htr=ssz_htr,
         state_transition=st,
         gossip=gossip,

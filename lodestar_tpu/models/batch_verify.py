@@ -403,14 +403,28 @@ def device_batch_verify(pk, h, sig, coeff_bits, mask) -> jax.Array:
     (N, 64) int32 MSB-first; mask: (N,) bool — False entries are padding.
     Returns a scalar bool array.
     """
+    from lodestar_tpu import telemetry
     from lodestar_tpu.ops import fp_pallas
 
-    if fp_pallas.use_pallas():
-        return _device_batch_verify_staged(pk, h, sig, coeff_bits, mask)
-    return _device_batch_verify_impl(
-        pk[0], pk[1], h[0], h[1], sig[0], sig[1],
-        jnp.asarray(coeff_bits), jnp.asarray(mask),
-    )
+    staged = fp_pallas.use_pallas()
+    # the verify core's jit-cache seam: one record per call (the staged
+    # chain is one logical launch unit of 3 dispatches), size class =
+    # the padded batch the executable was compiled for
+    t0 = time.perf_counter() if telemetry.launch_telemetry_active() else 0.0
+    if staged:
+        out = _device_batch_verify_staged(pk, h, sig, coeff_bits, mask)
+    else:
+        out = _device_batch_verify_impl(
+            pk[0], pk[1], h[0], h[1], sig[0], sig[1],
+            jnp.asarray(coeff_bits), jnp.asarray(mask),
+        )
+    if t0:
+        telemetry.record_launch(
+            "batch_verify_staged" if staged else "batch_verify",
+            int(pk[0].shape[0]),
+            time.perf_counter() - t0,
+        )
+    return out
 
 
 _device_batch_verify_many_impl = jax.jit(jax.vmap(_device_batch_verify_impl))
@@ -522,6 +536,9 @@ def device_batch_verify_sharded(mesh, pk, h, sig, coeff_bits, mask) -> jax.Array
     # with the persistent cache off, exactly the r4 behavior. The jitted
     # callable is memoized per (mesh, batch size); the flag flip is
     # lock-guarded against concurrent compiles.
+    from lodestar_tpu import telemetry
+
+    t_tel = time.perf_counter() if telemetry.launch_telemetry_active() else 0.0
     key = (tuple(d.id for d in mesh.devices.flat), pk[0].shape[0])
     jitted = _SHARDED_JIT_CACHE.get(key)
     if jitted is None:
@@ -565,6 +582,15 @@ def device_batch_verify_sharded(mesh, pk, h, sig, coeff_bits, mask) -> jax.Array
         pk[0], pk[1], h[0], h[1], sig[0], sig[1],
         jnp.asarray(coeff_bits), jnp.asarray(mask),
     )
+    if t_tel:
+        # the sharded collective's jit-cache seam: the in-process memo
+        # means only the first call per (mesh, batch) carries compile
+        telemetry.record_launch(
+            "batch_verify_sharded",
+            int(pk[0].shape[0]),
+            time.perf_counter() - t_tel,
+            lane=",".join(str(d.id) for d in mesh.devices.flat),
+        )
     return ok.all()
 
 

@@ -58,6 +58,7 @@ class BeaconNodeOptions:
         htr_device: str = "auto",
         bls_mesh: str = "auto",
         offload_tenant: str | None = None,
+        launch_telemetry: str = "auto",
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -188,6 +189,20 @@ class BeaconNodeOptions:
             except Exception as e:
                 raise ValueError(f"offload_tenant: {e}") from e
         self.offload_tenant = offload_tenant
+        # device launch telemetry (lodestar_tpu/telemetry.py): per-
+        # dispatch wall time / program / size class / compile detection
+        # at the counted launch seams. "auto" records once the node
+        # installs the metric sink (i.e. on every node); "off" leaves
+        # the seams one flag check from free. Validated against the
+        # telemetry module's canonical tuple (cli.py keeps a literal
+        # copy per the argparse-import doctrine)
+        from lodestar_tpu.telemetry import TELEMETRY_MODES
+
+        if launch_telemetry not in TELEMETRY_MODES:
+            raise ValueError(
+                f"launch_telemetry must be one of {TELEMETRY_MODES}, got {launch_telemetry!r}"
+            )
+        self.launch_telemetry = launch_telemetry
 
 
 class BeaconNode:
@@ -299,6 +314,20 @@ class BeaconNode:
 
         configure_device_htr(mode=opts.htr_device, metrics=metrics.ssz_htr)
 
+        # 2f. device launch telemetry: mode + the lodestar_device_launch_*
+        # sink (process-global — the dispatch seams live in ops/ssz/mesh
+        # layers below any node object); the slow-slot dump hook makes a
+        # slow slot name its launches inline
+        from lodestar_tpu import telemetry as _telemetry
+
+        _telemetry.configure_launch_telemetry(
+            mode=opts.launch_telemetry, metrics=metrics.device_launch
+        )
+        if opts.tracing_enabled:
+            from lodestar_tpu import tracing as _tracing
+
+            _tracing.configure(launches_supplier=_telemetry.slow_slot_launches)
+
         # 3. bls verifier — offload endpoints get the resilience stack:
         # breaker-guarded client, then the verified degradation chain
         # (every layer re-verifies; errors degrade, verdicts are final)
@@ -398,6 +427,7 @@ class BeaconNode:
                                 sched_metrics=metrics.sched,
                                 mesh_mode=opts.bls_mesh,
                                 pipeline=opts.bls_pipeline,
+                                pipeline_metrics=metrics.bls_pipeline,
                             ),
                         )
                     )
@@ -411,6 +441,7 @@ class BeaconNode:
                 sched_metrics=metrics.sched,
                 mesh_mode=opts.bls_mesh,
                 pipeline=opts.bls_pipeline,
+                pipeline_metrics=metrics.bls_pipeline,
             )
         else:
             bls = BlsSingleThreadVerifier()

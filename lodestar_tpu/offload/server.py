@@ -284,7 +284,9 @@ class BlsOffloadServer:
                 )
                 raise _Replied()
             granted = True
-            with rec.span("offload_device_verify", sets=len(sets)):
+            # tenant identity rides the server-side span home: a Chrome
+            # trace of a multi-tenant slot names who each verify served
+            with rec.span("offload_device_verify", sets=len(sets), tenant=tenant):
                 with self.occupancy.launch():
                     ok = bool(self.backend(sets))
             m = self._tenant_metrics

@@ -49,10 +49,13 @@ JAX backend (the r3 multichip-gate regression class).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lodestar_tpu import telemetry
 from lodestar_tpu.crypto.bls import curve as C
 from lodestar_tpu.crypto.bls import fields as F
 from lodestar_tpu.crypto.bls import hash_to_curve as H
@@ -181,7 +184,18 @@ def _dispatch(program, *args):
     c = _launch_counter
     if c is not None:
         c.inc()
-    return program(*args)
+    # launch telemetry rides THE counted seam: wall time at the
+    # dispatch call, program identity, and the padded batch size
+    # (the arrays arriving here are already size-class padded)
+    t0 = time.perf_counter() if telemetry.launch_telemetry_active() else 0.0
+    out = program(*args)
+    if t0:
+        telemetry.record_launch(
+            telemetry.program_name(program),
+            telemetry.launch_size_class(args),
+            time.perf_counter() - t0,
+        )
+    return out
 
 
 def pad_pow2(n: int, floor: int = 8) -> int:

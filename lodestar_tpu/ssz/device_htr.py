@@ -169,8 +169,16 @@ def _device_level(data: np.ndarray) -> np.ndarray:
         # counted HERE so hash_level dispatches (batch_container_roots
         # levels) and collector flushes feed the same launches metric
         m.launches.inc()
+    from lodestar_tpu import telemetry
+
+    # launch telemetry at the same dispatch site as the counter: one
+    # record per padded merkle_level launch, size class = the padded
+    # pair count (the compiled program's shape bucket)
+    t0 = time.perf_counter() if telemetry.launch_telemetry_active() else 0.0
     words = ops.words_from_bytes(data.tobytes())
     out = np.asarray(ops.merkle_level(words))
+    if t0:
+        telemetry.record_launch("merkle_level", size, time.perf_counter() - t0)
     roots = np.frombuffer(ops.bytes_from_words(out), dtype=np.uint8).reshape(-1, 32)
     return roots[:n]
 

@@ -1,0 +1,278 @@
+"""Device launch telemetry: per-dispatch wall time, program identity,
+size class, lane/device, and first-call compile detection.
+
+PR 9 proved launch count is a first-order lever (5→3 prep launches =
++23% replay throughput from overlap alone), and the "Enabling AI ASICs
+for ZKP" paper (PAPERS.md) makes launch/dispatch overhead the central
+argument — but until this module the system could only say *how many*
+device dispatches fired (`lodestar_bls_prep_launches_total`, the HTR
+dispatch counter, the per-lane launch counters), not where the wall
+time went: compile vs dispatch latency vs device execution, per program
+and size class. This module is the one seam every counted dispatch
+reports through:
+
+* `ops/prep.py:_dispatch` — every prep program launch (fused stages,
+  per-leg reference schedule, hash-to-G2).
+* `ssz/device_htr.py:_device_level` — every batched SHA-256 merkle
+  level dispatch (collector flushes + shared-hook batch levels).
+* `chain/bls/mesh.py:mesh_launch` — every verify launch a mesh lane
+  serves (the whole bytes-in → verdict-out chain on that lane).
+* `models/batch_verify.py` — the RLC verify core and the sharded
+  collective (the jit-cache seams the compile detection rides).
+
+What gets recorded per dispatch:
+
+* **wall seconds** — host-observed time inside the dispatch call. On
+  synchronous backends (CPU XLA) this includes device execution; on
+  async backends it is dispatch + any blocking host transfer the
+  program performs. Honest name: *launch wall time at the seam*, not
+  "device execution time" (that is the XLA profiler's job,
+  `utils/tracing.py`).
+* **program** — the dispatched callable's name (`_prep_field_stage`,
+  `merkle_level`, `bls_lane_verify`, ...).
+* **size class** — the pow-2-padded batch size (the compile-cache
+  bucketing of `ops/prep.pad_pow2`), so per-class latency is readable
+  and label cardinality stays logarithmic.
+* **compile** — first-call-per-(program, size class) detection: the
+  jit caches compile one program per (callable, shape bucket), so the
+  first dispatch of a key in this process pays trace+compile (or the
+  persistent-cache load) and every later one is a cache hit. The
+  first-call flag separates the minutes-long compile outliers from the
+  steady-state dispatch latency on the same histogram.
+* **lane/device** — which chip served (mesh seam), when known.
+
+Sinks:
+
+* Prometheus (`DeviceLaunchMetrics`, installed by the node):
+  `lodestar_device_launch_seconds{program,size_class}`,
+  `lodestar_device_compile_seconds_total`,
+  `lodestar_device_compile_{hits,misses}_total{program}`.
+* A bounded in-process **launch ledger** (deque, default 256 entries)
+  surfaced by `GET /eth/v0/debug/launches` and folded into slow-slot
+  dumps (`slow_slot_launches`) — a slow slot names its launches.
+
+Mode (`--launch-telemetry {auto,on,off}`, process-global like the prep
+and HTR modes): "auto" records once a metrics sink is installed (every
+node) and stays off in bare library use; "on" records even without
+metrics (ledger + process-local counters — tests, benches); "off"
+disables everything, leaving the seams one flag-check from free.
+
+This module imports nothing heavy (stdlib only) and never touches a
+JAX backend — the r3 import-hygiene doctrine; the seams that import it
+are the ones that already own a device dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "TELEMETRY_MODES",
+    "DEFAULT_LEDGER_SIZE",
+    "configure_launch_telemetry",
+    "launch_telemetry_active",
+    "record_launch",
+    "launch_size_class",
+    "size_class_of",
+    "launch_ledger",
+    "launch_totals",
+    "slow_slot_launches",
+    "reset_launch_telemetry",
+]
+
+TELEMETRY_MODES = ("auto", "on", "off")
+
+#: ledger bound: big enough to hold every dispatch of a slow slot
+#: (a worst-case import is tens of launches), small enough that the
+#: debug route and the slow-slot dump stay cheap to serialize
+DEFAULT_LEDGER_SIZE = 256
+
+_mode = "auto"  # guarded by: config-time (node init / test setup writes; hot-path reads tolerate either value)
+_metrics = None  # guarded by: config-time (DeviceLaunchMetrics slot, set once at node init)
+
+_lock = threading.Lock()
+_ledger: deque = deque(maxlen=DEFAULT_LEDGER_SIZE)  # guarded by: _lock
+_seen_keys: set = set()  # guarded by: _lock — (program, size_class) compile-detection keys
+_seq = 0  # guarded by: _lock — monotonic dispatch sequence number
+_compiles = 0  # guarded by: _lock — first-call dispatches observed
+
+
+def configure_launch_telemetry(
+    mode: str | None = None, metrics=None, ledger_size: int | None = None
+) -> str:
+    """Set the process-wide telemetry mode and/or install the
+    `lodestar_device_launch_*` metric family (node init; tests flip the
+    mode around calls). Returns the PREVIOUS mode so callers can
+    save/restore."""
+    global _mode, _metrics, _ledger
+    prev = _mode
+    if mode is not None:
+        if mode not in TELEMETRY_MODES:
+            raise ValueError(
+                f"launch_telemetry must be one of {TELEMETRY_MODES}, got {mode!r}"
+            )
+        _mode = mode
+    if metrics is not None:
+        _metrics = metrics
+    if ledger_size is not None:
+        with _lock:
+            _ledger = deque(_ledger, maxlen=ledger_size)
+    return prev
+
+
+def launch_telemetry_active() -> bool:
+    """Whether the dispatch seams should pay the clock reads: "on"
+    always, "off" never, "auto" once a metrics sink is installed (the
+    node installs one at init; bare library use stays free)."""
+    if _mode == "on":
+        return True
+    if _mode == "off":
+        return False
+    return _metrics is not None
+
+
+def size_class_of(n: int, floor: int = 8) -> int:
+    """Pow-2 size-class bucketing for a raw batch size — the same
+    shape-bucket the compile caches key on (`ops/prep.pad_pow2`,
+    reimplemented here so jax-free seams like chain/bls/mesh.py can
+    label without importing the ops layer)."""
+    return max(floor, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+def launch_size_class(args) -> int:
+    """Leading-axis size of the first array-shaped thing in `args`
+    (recursing into tuples/lists — device programs take point tuples).
+    The dispatch seams hand padded arrays in, so this IS the size
+    class; returns 0 when nothing array-shaped is found."""
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape:
+            return int(shape[0])
+        if isinstance(a, (tuple, list)) and a:
+            n = launch_size_class(a)
+            if n:
+                return n
+    return 0
+
+
+def program_name(program) -> str:
+    """Stable identity label for a dispatched callable (jit wrappers
+    preserve `__name__` via functools.wraps)."""
+    name = getattr(program, "__name__", None)
+    if name:
+        return name
+    return type(program).__name__
+
+
+def record_launch(
+    program: str,
+    size_class: int,
+    seconds: float,
+    *,
+    lane: str | None = None,
+) -> dict | None:
+    """Record one device dispatch: ledger entry + metric observations.
+
+    Compile detection is first-call-per-(program, size_class): the jit
+    caches hold one executable per key, so the first dispatch of a key
+    in this process carries trace+compile (or the persistent-cache
+    load) and is counted as a miss; every later dispatch of the key is
+    a hit. Returns the ledger entry (tests), or None when inactive."""
+    if not launch_telemetry_active():
+        return None
+    global _seq, _compiles
+    key = (program, size_class)
+    with _lock:
+        _seq += 1
+        compile_ = key not in _seen_keys
+        _seen_keys.add(key)
+        if compile_:
+            _compiles += 1
+        entry = {
+            "seq": _seq,
+            "program": program,
+            "size_class": size_class,
+            "seconds": seconds,
+            "lane": lane,
+            "compile": compile_,
+            "t_mono_ns": time.monotonic_ns(),
+        }
+        _ledger.append(entry)
+    m = _metrics
+    if m is not None:
+        try:
+            m.launch_seconds.labels(program, str(size_class)).observe(seconds)
+            if compile_:
+                m.compile_misses.labels(program).inc()
+                m.compile_seconds.inc(seconds)
+            else:
+                m.compile_hits.labels(program).inc()
+        except Exception:
+            pass  # the metric bridge must never fail a device dispatch
+    return entry
+
+
+def launch_ledger(n: int | None = None) -> list[dict]:
+    """The most recent `n` ledger entries (all when None), oldest
+    first. Entries are copies — callers can't corrupt the ledger."""
+    with _lock:
+        entries = list(_ledger)
+    if n is not None and n >= 0:
+        entries = entries[-n:] if n else []
+    return [dict(e) for e in entries]
+
+
+def launch_totals() -> dict:
+    """Cumulative view for the debug route: dispatch count, compile
+    count, distinct (program, size_class) keys, and per-program launch
+    counts over the CURRENT ledger window (the full-history numbers
+    are the Prometheus counters)."""
+    with _lock:
+        entries = list(_ledger)
+        seq = _seq
+        compiles = _compiles
+        keys = len(_seen_keys)
+    by_program: dict[str, int] = {}
+    for e in entries:
+        by_program[e["program"]] = by_program.get(e["program"], 0) + 1
+    return {
+        "launches": seq,
+        "compiles": compiles,
+        "distinct_keys": keys,
+        "ledger_entries": len(entries),
+        "ledger_by_program": by_program,
+    }
+
+
+def slow_slot_launches(n: int = 12) -> dict:
+    """Compact launch view for slow-slot dumps: the trailing `n`
+    dispatches as one-line strings plus the cumulative counts — a slow
+    slot names its launches without a second query."""
+    entries = launch_ledger(n)
+    recent = [
+        "{program}/{size_class} {ms:.1f}ms{lane}{comp}".format(
+            program=e["program"],
+            size_class=e["size_class"],
+            ms=e["seconds"] * 1000.0,
+            lane=f" @{e['lane']}" if e["lane"] else "",
+            comp=" [compile]" if e["compile"] else "",
+        )
+        for e in entries
+    ]
+    with _lock:
+        return {"launches_total": _seq, "compiles_total": _compiles, "recent": recent}
+
+
+def reset_launch_telemetry() -> None:
+    """Fresh disabled-ish state (test isolation): mode back to auto,
+    metrics detached, ledger/keys/counters cleared."""
+    global _mode, _metrics, _ledger, _seq, _compiles
+    with _lock:
+        _mode = "auto"
+        _metrics = None
+        _ledger = deque(maxlen=DEFAULT_LEDGER_SIZE)
+        _seen_keys.clear()
+        _seq = 0
+        _compiles = 0

@@ -274,6 +274,7 @@ class Tracer:
         ring_size: int = 64,
         metrics=None,
         lag_ms_supplier=None,
+        launches_supplier=None,
     ):
         self.enabled = enabled
         self.slow_slot_ms = slow_slot_ms
@@ -287,6 +288,10 @@ class Tracer:
         # () -> float|None: last event-loop lag sample in ms, surfaced in
         # slow-slot dumps (EventLoopLagSampler wires itself in here)
         self.lag_ms_supplier = lag_ms_supplier
+        # () -> dict|None: recent device-launch ledger view
+        # (telemetry.slow_slot_launches), folded into slow-slot dumps so
+        # a slow slot names its launches (compile vs dispatch) inline
+        self.launches_supplier = launches_supplier
         self.slow_slot_dumps = 0
         self.last_slow_dump: dict | None = None
         self._lock = threading.Lock()
@@ -395,6 +400,15 @@ class Tracer:
                     info["event_loop_lag_ms"] = round(lag_ms, 3)
             except Exception:
                 pass  # the dump must never fail on an optional probe
+        if self.launches_supplier is not None:
+            # the slot's device launches (program/size/wall/compile):
+            # compile stall vs dispatch storm is readable from the dump
+            try:
+                launches = self.launches_supplier()
+                if launches is not None:
+                    info["device_launches"] = launches
+            except Exception:
+                pass  # the dump must never fail on an optional probe
         with self._lock:
             self.slow_slot_dumps += 1
             self.last_slow_dump = info
@@ -459,6 +473,7 @@ def configure(
     ring_size: int | None = None,
     metrics=None,
     lag_ms_supplier=None,
+    launches_supplier=None,
 ) -> Tracer:
     """Mutate the global tracer in place (callers hold no stale refs)."""
     t = _TRACER
@@ -479,6 +494,8 @@ def configure(
         t.metrics = metrics
     if lag_ms_supplier is not None:
         t.lag_ms_supplier = lag_ms_supplier
+    if launches_supplier is not None:
+        t.launches_supplier = launches_supplier
     return t
 
 

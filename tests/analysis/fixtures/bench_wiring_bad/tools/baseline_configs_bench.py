@@ -1,0 +1,13 @@
+"""bench-wiring bad fixture: reporting seam with every gap class."""
+
+
+def _line(metric, value, unit, vs):
+    print(metric, value, unit, vs)
+
+
+def report(name_var, n_dev):
+    _line("gated_line_per_sec", 1.0, "ops", 1.0)  # clean: gated
+    _line("orphan_line_per_sec", 2.0, "ops", 1.0)  # BAD: no threshold
+    _line(f"gated_family_{n_dev}dev", 3.0, "ops", 1.0)  # clean: pattern gated
+    _line(f"orphan_family_{n_dev}dev", 4.0, "ops", 1.0)  # BAD: pattern gates nothing
+    _line(name_var, 5.0, "ops", 1.0)  # BAD: not statically derivable

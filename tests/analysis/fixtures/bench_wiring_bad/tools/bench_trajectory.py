@@ -1,0 +1,12 @@
+"""bench-wiring bad fixture: trajectory gate with stale entries."""
+
+THRESHOLDS = {
+    "gated_line_per_sec": 0.5,
+    "gated_family_2dev": 0.5,
+    "ghost_metric_per_sec": 0.5,  # BAD: nobody reports this line
+}
+
+LOWER_IS_BETTER = {
+    "gated_line_per_sec",
+    "never_a_threshold_ms",  # BAD: direction flag for a nonexistent key
+}

@@ -1,0 +1,5 @@
+"""bench-wiring ok fixture: the bench.py one-line headline shape."""
+
+
+def bench_headline():
+    return {"metric": "headline_per_sec", "value": 1.0, "unit": "ops", "vs_baseline": 1.0}

@@ -1,0 +1,11 @@
+"""bench-wiring ok fixture: every line gated, every gate reported."""
+
+
+def _line(metric, value, unit, vs):
+    print(metric, value, unit, vs)
+
+
+def report(n_dev, suffix):
+    _line("gated_line_per_sec", 1.0, "ops", 1.0)
+    _line(f"gated_family_{n_dev}dev", 3.0, "ops", 1.0)
+    _line(f"replay_sigs_per_sec{suffix}", 4.0, "sigs/s", 1.0)  # suffix may be ""

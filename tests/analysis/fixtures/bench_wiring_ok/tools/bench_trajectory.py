@@ -1,0 +1,13 @@
+"""bench-wiring ok fixture: thresholds matching the reported lines."""
+
+THRESHOLDS = {
+    "gated_line_per_sec": 0.5,
+    "gated_family_2dev": 0.5,
+    "replay_sigs_per_sec": 0.5,
+    "replay_sigs_per_sec_device": 0.5,
+    "headline_per_sec": 0.5,
+}
+
+LOWER_IS_BETTER = {
+    "gated_line_per_sec",
+}

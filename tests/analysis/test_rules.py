@@ -310,3 +310,56 @@ def test_fault_wiring_real_tree_is_clean():
         pragma_hygiene=False,
     )
     assert findings == [], [f.format() for f in findings]
+
+
+# -- bench-wiring (project-scoped) --------------------------------------------
+
+
+def bench_wiring_findings(root: str):
+    return analyze(
+        [],
+        rules=[RULES_BY_NAME["bench-wiring"]],
+        repo_root=FIXTURES / root,
+        pragma_hygiene=False,
+    )
+
+
+def test_bench_wiring_flags_every_gap_class():
+    msgs = [f.message for f in bench_wiring_findings("bench_wiring_bad")]
+    joined = " | ".join(msgs)
+    # thresholds -> bench: gated name nobody reports
+    assert "'ghost_metric_per_sec' names no bench line" in joined
+    # bench -> thresholds: reported literal with no gate
+    assert "bench line 'orphan_line_per_sec' has no THRESHOLDS entry" in joined
+    # f-string pattern gating nothing
+    assert "pattern 'orphan_family_{…}dev' matches no THRESHOLDS entry" in joined
+    # non-static reporting name
+    assert "not a literal or f-string" in joined
+    # direction-set hygiene
+    assert "'never_a_threshold_ms' is not a THRESHOLDS key" in joined
+    # the gated literal and the gated family pattern stay quiet
+    assert "gated_line_per_sec" not in joined or "'gated_line_per_sec' names no" not in joined
+    assert len(msgs) == 5, joined
+
+
+def test_bench_wiring_clean_tree():
+    assert bench_wiring_findings("bench_wiring_ok") == []
+
+
+def test_bench_wiring_empty_suffix_interpolation_matches():
+    """`_line(f"name{suffix}")` with suffix "" must match the bare
+    THRESHOLDS key — the wildcard is .*?, not .+? (the real tree's
+    gossip_replay_sigs_per_sec line regressed exactly this way)."""
+    findings = bench_wiring_findings("bench_wiring_ok")
+    assert not any("replay_sigs_per_sec" in f.message for f in findings)
+
+
+def test_bench_wiring_real_tree_is_clean():
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    findings = analyze(
+        [],
+        rules=[RULES_BY_NAME["bench-wiring"]],
+        repo_root=repo,
+        pragma_hygiene=False,
+    )
+    assert findings == [], [f.format() for f in findings]

@@ -213,3 +213,36 @@ def test_debug_and_config(env):
     assert any(n["parent_root"] is None for n in nodes)
     contract = client._req("GET", "/eth/v1/config/deposit_contract")["data"]
     assert "address" in contract
+
+
+def test_debug_launches_route_contract(env):
+    """GET /eth/v0/debug/launches: the launch-telemetry ledger behind
+    the debug namespace — totals + entries, count slicing, 400 on a
+    non-integer count."""
+    from lodestar_tpu import telemetry
+
+    p, chain, blocks, client = env
+    telemetry.reset_launch_telemetry()
+    telemetry.configure_launch_telemetry(mode="on")
+    try:
+        for i in range(5):
+            telemetry.record_launch("contract_prog", 8, 0.001 * (i + 1), lane="dev0")
+        out = client._req("GET", "/eth/v0/debug/launches")["data"]
+        assert out["mode_active"] is True
+        assert out["totals"]["launches"] == 5
+        assert out["totals"]["ledger_by_program"] == {"contract_prog": 5}
+        assert len(out["launches"]) == 5
+        entry = out["launches"][-1]
+        assert entry["program"] == "contract_prog"
+        assert entry["size_class"] == 8
+        assert entry["lane"] == "dev0"
+        assert entry["compile"] is False  # only the first (prog, 8) compiled
+        # count slicing keeps the NEWEST entries
+        out2 = client._req("GET", "/eth/v0/debug/launches", {"count": "2"})["data"]
+        assert [e["seq"] for e in out2["launches"]] == [4, 5]
+        # contract: non-integer count is a 400, not a 500
+        with pytest.raises(ApiClientError) as e:
+            client._req("GET", "/eth/v0/debug/launches", {"count": "soon"})
+        assert e.value.status == 400
+    finally:
+        telemetry.reset_launch_telemetry()

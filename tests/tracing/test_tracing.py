@@ -252,3 +252,39 @@ def test_cli_exposes_tracing_flags():
     assert args.tracing_export_dir == "/tmp/traces"
     dev = ap.parse_args(["dev", "--tracing"])
     assert dev.tracing is True
+
+
+def test_slow_slot_dump_names_its_launches():
+    """With the telemetry supplier wired (node init does this), a slow
+    slot's dump carries the trailing device launches — the "prep wall
+    time or dispatch latency?" read without a second query."""
+    import time
+
+    from lodestar_tpu import telemetry
+
+    telemetry.reset_launch_telemetry()
+    telemetry.configure_launch_telemetry(mode="on")
+    try:
+        telemetry.record_launch("_prep_field_stage", 32, 0.0123)
+        telemetry.record_launch("bls_lane_verify", 32, 0.0456, lane="dev1")
+        t = tracing.configure(
+            enabled=True, slow_slot_ms=1.0,
+            launches_supplier=telemetry.slow_slot_launches,
+        )
+        with tracing.root("block_import", slot=6):
+            time.sleep(0.005)
+        dump = t.last_slow_dump
+        assert dump is not None and "device_launches" in dump
+        launches = dump["device_launches"]
+        assert launches["launches_total"] == 2
+        assert launches["recent"][0].startswith("_prep_field_stage/32 12.3ms")
+        assert "@dev1" in launches["recent"][1]
+        # a supplier blow-up must never fail the dump
+        t.launches_supplier = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        t.slow_slot_ms = 1.0
+        with tracing.root("block_import", slot=7):
+            time.sleep(0.005)
+        assert t.slow_slot_dumps == 2
+        assert "device_launches" not in t.last_slow_dump
+    finally:
+        telemetry.reset_launch_telemetry()

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from .bench_wiring import BenchWiringRule
 from .blocking_under_lock import BlockingUnderLockRule
 from .fail_closed import FailClosedVerdictsRule
 from .fault_wiring import FaultWiringRule
@@ -20,6 +21,7 @@ ALL_RULES = (
     MetricsCliWiringRule(),
     RestRouteWiringRule(),
     FaultWiringRule(),
+    BenchWiringRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -1,0 +1,249 @@
+"""bench-wiring: bench line names ↔ trajectory regression thresholds,
+both directions — the cross-file sibling of the metrics/REST/fault
+wiring rules (same doctrine: a bench line the gate never checks, or a
+threshold gating a line nobody emits, silently does nothing exactly
+when the chip run depends on it).
+
+Project-scoped over three fixed locations:
+
+* ``tools/baseline_configs_bench.py`` — every ``_line("name", ...)``
+  reporting call. Literal first args are exact line names; f-string
+  first args (``f"mesh_sigs_per_sec_{n}dev"``) become match patterns
+  with each interpolation treated as a wildcard; anything else (a bare
+  variable) is flagged — a dynamically-built name cannot be statically
+  gated, so the reporting seam must keep names derivable.
+* ``bench.py`` (repo root) — dict literals carrying a constant
+  ``"metric"`` key (the config-1 headline shape the r1–r5 trajectory
+  files record).
+* ``tools/bench_trajectory.py`` — the ``THRESHOLDS`` dict literal (the
+  per-line regression gate) and ``LOWER_IS_BETTER`` (direction set).
+
+Checks:
+
+1. **thresholds → bench**: every ``THRESHOLDS`` key must be emitted by
+   some reporting call (exact literal or f-string pattern match) — a
+   stale threshold is a standing license for a renamed line to escape
+   the gate.
+2. **bench → thresholds**: every literal line name must have a
+   ``THRESHOLDS`` entry, and every f-string pattern must match at
+   least one — an ungated line regresses silently on the next round.
+3. **direction hygiene**: every ``LOWER_IS_BETTER`` member must be a
+   ``THRESHOLDS`` key — a direction flag for a nonexistent metric is
+   dead configuration.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from ..core import Finding, Rule
+
+BENCH_REL = Path("tools") / "baseline_configs_bench.py"
+HEADLINE_REL = Path("bench.py")
+TRAJECTORY_REL = Path("tools") / "bench_trajectory.py"
+REPORT_FN = "_line"
+THRESHOLDS_NAME = "THRESHOLDS"
+DIRECTION_NAME = "LOWER_IS_BETTER"
+
+
+def _parse(path: Path):
+    try:
+        return ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    except SyntaxError:
+        return None  # surfaced separately by the parse rule
+
+
+def _reported_names(tree: ast.Module):
+    """(exact names, (pattern, source_text) pairs, non-static finding
+    sites) from `_line(first_arg, ...)` calls."""
+    exact: list[tuple[str, int]] = []
+    patterns: list[tuple[re.Pattern, str, int]] = []
+    dynamic: list[int] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == REPORT_FN
+            and node.args
+        ):
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            exact.append((first.value, node.lineno))
+        elif isinstance(first, ast.JoinedStr):
+            parts = []
+            text = []
+            for piece in first.values:
+                if isinstance(piece, ast.Constant):
+                    parts.append(re.escape(str(piece.value)))
+                    text.append(str(piece.value))
+                else:
+                    # .*? not .+?: an interpolation may be empty (the
+                    # `_line(f"name{suffix}")` pattern with suffix "")
+                    parts.append(".*?")
+                    text.append("{…}")
+            patterns.append((re.compile("^" + "".join(parts) + "$"), "".join(text), node.lineno))
+        else:
+            dynamic.append(node.lineno)
+    return exact, patterns, dynamic
+
+
+def _headline_names(tree: ast.Module) -> list[tuple[str, int]]:
+    """Constant "metric" values in dict literals (bench.py's one-line
+    JSON shape)."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            if (
+                isinstance(key, ast.Constant)
+                and key.value == "metric"
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
+            ):
+                out.append((value.value, value.lineno))
+    return out
+
+
+def _dict_literal_keys(tree: ast.Module, name: str) -> dict[str, int]:
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == name for t in targets):
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            return {
+                k.value: k.lineno
+                for k in value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+    return {}
+
+
+def _set_literal_members(tree: ast.Module, name: str) -> dict[str, int]:
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == name for t in targets):
+            continue
+        value = node.value
+        if isinstance(value, ast.Set):
+            return {
+                e.value: e.lineno
+                for e in value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+    return {}
+
+
+class BenchWiringRule(Rule):
+    name = "bench-wiring"
+    description = (
+        "bench line names and trajectory regression thresholds agree "
+        "both ways (literal/f-string derivable reporting, gated lines, "
+        "direction-set hygiene)"
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        findings: list[Finding] = []
+        bench_path = repo_root / BENCH_REL
+        traj_path = repo_root / TRAJECTORY_REL
+        if not bench_path.is_file() or not traj_path.is_file():
+            return findings  # tree without the bench tooling: nothing to wire
+        bench_tree = _parse(bench_path)
+        traj_tree = _parse(traj_path)
+        if bench_tree is None or traj_tree is None:
+            return findings
+
+        exact, patterns, dynamic = _reported_names(bench_tree)
+        # carry the SOURCE file per exact name so an ungated headline
+        # from bench.py is reported against bench.py, not misattributed
+        # to baseline_configs_bench.py at an unrelated line
+        exact = [(name, str(bench_path), line) for name, line in exact]
+        headline_path = repo_root / HEADLINE_REL
+        if headline_path.is_file():
+            headline_tree = _parse(headline_path)
+            if headline_tree is not None:
+                for name, line in _headline_names(headline_tree):
+                    exact.append((name, str(headline_path), line))
+
+        for line in dynamic:
+            findings.append(
+                Finding(
+                    self.name, str(bench_path), line,
+                    f"{REPORT_FN}() first argument is not a literal or "
+                    "f-string — the bench line name cannot be statically "
+                    "gated by the trajectory thresholds",
+                )
+            )
+
+        thresholds = _dict_literal_keys(traj_tree, THRESHOLDS_NAME)
+        direction = _set_literal_members(traj_tree, DIRECTION_NAME)
+        if not thresholds:
+            findings.append(
+                Finding(
+                    self.name, str(traj_path), 1,
+                    f"no literal {THRESHOLDS_NAME} dict found — the "
+                    "regression gate has no statically checkable lines",
+                )
+            )
+            return findings
+
+        exact_names = {n for n, _, _ in exact}
+        # thresholds -> bench: every gated name is actually reported
+        for key, line in sorted(thresholds.items()):
+            if key in exact_names:
+                continue
+            if any(p.match(key) for p, _, _ in patterns):
+                continue
+            findings.append(
+                Finding(
+                    self.name, str(traj_path), line,
+                    f"{THRESHOLDS_NAME} entry '{key}' names no bench line "
+                    "reported by baseline_configs_bench.py or bench.py — "
+                    "remove the stale threshold or fix the line name",
+                )
+            )
+        # bench -> thresholds: every reported line is gated
+        seen: set = set()
+        for name, src_path, line in exact:
+            if name in seen:
+                continue
+            seen.add(name)
+            if name not in thresholds:
+                findings.append(
+                    Finding(
+                        self.name, src_path, line,
+                        f"bench line '{name}' has no {THRESHOLDS_NAME} entry "
+                        "in bench_trajectory.py — the line would regress "
+                        "ungated",
+                    )
+                )
+        for pattern, text, line in patterns:
+            if not any(pattern.match(key) for key in thresholds):
+                findings.append(
+                    Finding(
+                        self.name, str(bench_path), line,
+                        f"bench line pattern '{text}' matches no "
+                        f"{THRESHOLDS_NAME} entry — the lines it emits would "
+                        "regress ungated",
+                    )
+                )
+        # direction hygiene
+        for member, line in sorted(direction.items()):
+            if member not in thresholds:
+                findings.append(
+                    Finding(
+                        self.name, str(traj_path), line,
+                        f"{DIRECTION_NAME} member '{member}' is not a "
+                        f"{THRESHOLDS_NAME} key — dead direction flag",
+                    )
+                )
+        return findings

@@ -100,6 +100,12 @@ _PROMQL_WORDS = {
 }
 
 _TOKEN_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+#: non-metric positions stripped before tokenizing: `by (...)` /
+#: `without (...)` grouping clauses and `{...}` label selectors hold
+#: LABEL names (e.g. size_class), which are not sample names and must
+#: not be checked against the registry
+_GROUP_CLAUSE_RE = re.compile(r"\b(?:by|without)\s*\(([^)]*)\)")
+_LABEL_SELECTOR_RE = re.compile(r"\{[^}]*\}")
 _METRIC_METHODS = {"counter", "gauge", "histogram", "summary"}
 
 
@@ -173,7 +179,10 @@ def dashboard_tokens(dash_dir: Path) -> dict[str, set]:
         dash = json.loads(path.read_text(encoding="utf-8"))
         for panel in dash.get("panels", []):
             for target in panel.get("targets", []):
-                for tok in _TOKEN_RE.findall(target.get("expr", "")):
+                expr = target.get("expr", "")
+                expr = _LABEL_SELECTOR_RE.sub("", expr)
+                expr = _GROUP_CLAUSE_RE.sub("", expr)
+                for tok in _TOKEN_RE.findall(expr):
                     if "_" in tok and tok not in _PROMQL_WORDS:
                         tokens.add(tok)
         out[str(path)] = tokens

@@ -172,7 +172,11 @@ def config4_merkle_1m():
     import bench as b
 
     out = b.bench_merkle(depth=18 if QUICK else 20)
-    _line(out["metric"], out["value"], out["unit"], out["vs_baseline"])
+    # literal metric name (asserted against bench.py's) so the bench
+    # trajectory's per-line thresholds are statically checkable against
+    # this module's reporting (tools/analysis bench-wiring rule)
+    assert out["metric"] == "merkle_sha256_pair_hashes_per_sec", out["metric"]
+    _line("merkle_sha256_pair_hashes_per_sec", out["value"], out["unit"], out["vs_baseline"])
 
 
 def config5_backfill_window():

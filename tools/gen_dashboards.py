@@ -6,10 +6,12 @@ scraping the node renders them unmodified).
 Run from the repo root: python tools/gen_dashboards.py
 """
 
+import glob
 import json
 import os
 
 OUT = "dashboards"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def panel(title, exprs, *, unit="short", x=0, y=0, w=12, h=8, pid=1, kind="timeseries"):
@@ -25,6 +27,19 @@ def panel(title, exprs, *, unit="short", x=0, y=0, w=12, h=8, pid=1, kind="times
         "gridPos": {"x": x, "y": y, "w": w, "h": h},
         "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
         "targets": targets,
+    }
+
+
+def text_panel(title, content, *, x=0, y=0, w=24, h=8, pid=1):
+    """Markdown panel (no Prometheus targets — static content baked at
+    generation time, e.g. the bench trajectory table)."""
+    return {
+        "id": pid,
+        "title": title,
+        "type": "text",
+        "gridPos": {"x": x, "y": y, "w": w, "h": h},
+        "options": {"mode": "markdown", "content": content},
+        "targets": [],
     }
 
 
@@ -146,6 +161,27 @@ def bls_pool():
                 ),
             ],
             unit="ops", x=12, y=24, pid=8,
+        ),
+        panel(
+            # live export of the pool's pipeline_stats(): how much of
+            # verify wall time carried a prep stage in flight (the PR 9
+            # bench line, now readable during a run) and whether the
+            # double buffer engaged at all (0 staged packages = it
+            # never did — 1-lane auto, or no stageable lanes)
+            "Prep→verify pipeline overlap",
+            [
+                ("lodestar_bls_pipeline_overlap_occupancy_pct", "overlap % of verify time"),
+                ("lodestar_bls_pipeline_staged_packages", "staged packages (cum)"),
+            ],
+            x=0, y=32, pid=9,
+        ),
+        panel(
+            "Pipeline stage busy time (rate of cumulative seconds)",
+            [
+                ("rate(lodestar_bls_pipeline_prep_seconds_total[5m])", "prep busy s/s"),
+                ("rate(lodestar_bls_pipeline_verify_seconds_total[5m])", "verify busy s/s"),
+            ],
+            x=12, y=32, pid=10,
         ),
     ]
     return dashboard("lodestar-bls-pool", "Lodestar TPU - BLS verifier pool", ps, ["lodestar", "bls"])
@@ -387,6 +423,137 @@ def mesh_serving_dashboard():
     )
 
 
+def _bench_trajectory_markdown():
+    """Markdown table of the BENCH_rNN.json trajectory, baked at
+    generation time (tools/bench_trajectory.py regenerates dashboards
+    after writing each round, so this panel tracks the trajectory).
+    Handles both the r1–r5 single-``parsed`` shape and the r6+
+    ``lines`` shape."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        n = doc.get("n", "?")
+        label = doc.get("label", "")
+        lines = [l for l in doc.get("lines") or [] if isinstance(l, dict) and "metric" in l]
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            lines.append(parsed)
+        for line in lines:
+            rows.append(
+                "| r{n:02d} | `{metric}` | {value} {unit} | {vs} | {label} |".format(
+                    n=int(n) if isinstance(n, int) else 0,
+                    metric=line.get("metric", "?"),
+                    value=line.get("value", "?"),
+                    unit=line.get("unit", ""),
+                    vs=line.get("vs_baseline", ""),
+                    label=label,
+                )
+            )
+    header = (
+        "### Bench trajectory (BENCH_rNN.json)\n\n"
+        "Written by `tools/bench_trajectory.py` — each round is gated "
+        "line-by-line against the prior round (exit nonzero on "
+        "regression). CPU-container rounds validate schedule shape, "
+        "not chip throughput; read the label column.\n\n"
+        "| round | metric | value | vs baseline | label |\n"
+        "|---|---|---|---|---|\n"
+    )
+    return header + "\n".join(rows) + "\n"
+
+
+def device_launches_dashboard():
+    """Device launch telemetry (lodestar_tpu/telemetry.py): per-program
+    dispatch latency and rate at the counted launch seams, the
+    compile-vs-dispatch decomposition (first-call detection per
+    (program, size class)), and the bench trajectory. The "where did
+    the chip run's wall time go" dashboard the hardware measurement
+    campaign reads."""
+    ps = [
+        panel(
+            "Launch rate by program",
+            [
+                (
+                    "sum by (program) (rate(lodestar_device_launch_seconds_count[5m]))",
+                    "{{program}}",
+                ),
+            ],
+            unit="ops", pid=1,
+        ),
+        panel(
+            "Launch wall time p95 by program",
+            [
+                (
+                    "histogram_quantile(0.95, sum by (program, le) "
+                    "(rate(lodestar_device_launch_seconds_bucket[5m])))",
+                    "{{program}}",
+                ),
+            ],
+            unit="s", x=12, pid=2,
+        ),
+        panel(
+            "Launch wall time p95 by size class",
+            [
+                (
+                    "histogram_quantile(0.95, sum by (size_class, le) "
+                    "(rate(lodestar_device_launch_seconds_bucket[5m])))",
+                    "class {{size_class}}",
+                ),
+            ],
+            unit="s", y=8, pid=3,
+        ),
+        panel(
+            # compile vs dispatch: misses are first-call-per-(program,
+            # size class) dispatches that paid trace+compile (or the
+            # persistent-cache load); a miss spike in steady state means
+            # a new shape bucket leaked into the hot path
+            "Compile hits / misses by program",
+            [
+                (
+                    "sum by (program) (rate(lodestar_device_compile_hits_total[5m]))",
+                    "hit {{program}}",
+                ),
+                (
+                    "sum by (program) (rate(lodestar_device_compile_misses_total[5m]))",
+                    "MISS {{program}}",
+                ),
+            ],
+            unit="ops", x=12, y=8, pid=4,
+        ),
+        panel(
+            "Compile wall time (first-call dispatches, s/s)",
+            [
+                ("rate(lodestar_device_compile_seconds_total[5m])", "compile s/s"),
+            ],
+            y=16, pid=5,
+        ),
+        panel(
+            "Launch time share by program (sum/s)",
+            [
+                (
+                    "sum by (program) (rate(lodestar_device_launch_seconds_sum[5m]))",
+                    "{{program}}",
+                ),
+            ],
+            unit="s", x=12, y=16, pid=6,
+        ),
+        text_panel(
+            "Bench trajectory",
+            _bench_trajectory_markdown(),
+            y=24, pid=7,
+        ),
+    ]
+    return dashboard(
+        "lodestar-device-launches",
+        "Lodestar TPU - Device launch telemetry",
+        ps,
+        ["lodestar", "telemetry"],
+    )
+
+
 def all_dashboards():
     return (
         ("lodestar_bls_verifier_pool.json", bls_pool()),
@@ -403,6 +570,7 @@ def all_dashboards():
         ("lodestar_ssz_htr.json", ssz_htr_dashboard()),
         ("lodestar_node_internals.json", node_internals_dashboard()),
         ("lodestar_mesh_serving.json", mesh_serving_dashboard()),
+        ("lodestar_device_launches.json", device_launches_dashboard()),
     )
 
 
