@@ -99,8 +99,13 @@ class MeshLane:
     flight on this lane) and is only touched on the event loop; the
     occupancy tracker and breaker are thread-safe because the launches
     themselves run on executor threads. `verify_prepared_fn` (optional)
-    verifies a `PreparedSets.inputs` tuple staged by the pipelined
-    pool's prep stage; lanes without one always re-prep inline."""
+    verifies a `PreparedSets.inputs` staged by the pipelined pool's
+    prep stage (either staged shape — see models verify_prepared);
+    lanes without one always re-prep inline. `verify_single_fn`
+    (optional) is the lane-pinned single-launch entry
+    (models `make_lane_verify_single_fn`): `mesh_launch` prefers it for
+    unstaged work while `--bls-single-launch` resolves active, so a
+    whole batch is one resident program on this lane's die."""
 
     def __init__(
         self,
@@ -111,6 +116,7 @@ class MeshLane:
         wedge_threshold: int = LANE_WEDGE_THRESHOLD,
         wedge_reset_s: float = LANE_WEDGE_RESET_S,
         verify_prepared_fn: Callable | None = None,
+        verify_single_fn: Callable | None = None,
     ) -> None:
         from lodestar_tpu.offload.resilience import CircuitBreaker
 
@@ -118,6 +124,7 @@ class MeshLane:
         self.label = label if label is not None else f"dev{index}"
         self.verify_fn = verify_fn
         self.verify_prepared_fn = verify_prepared_fn
+        self.verify_single_fn = verify_single_fn
         self.occupancy = OccupancyTracker()
         self.breaker = CircuitBreaker(
             failure_threshold=wedge_threshold,
@@ -200,6 +207,15 @@ class VerifierMesh:
         return [lane.state() for lane in self.lanes]
 
 
+def _single_launch_active() -> bool:
+    """Whether `--bls-single-launch` resolves active right now. Only
+    consulted when a lane carries a `verify_single_fn` (which came from
+    the models layer), so mock-lane meshes never pay the import."""
+    from lodestar_tpu.models.batch_verify import single_launch_active
+
+    return single_launch_active()
+
+
 def mesh_launch(
     mesh: VerifierMesh,
     sets,
@@ -254,6 +270,16 @@ def mesh_launch(
                     dispatched = False  # no backend call — not a launch
                 elif use_staged and current.verify_prepared_fn is not None:
                     ok = bool(current.verify_prepared_fn(prepared.inputs))
+                elif (
+                    current.verify_single_fn is not None
+                    and _single_launch_active()
+                ):
+                    # lane-pinned single-launch road (one resident
+                    # program per batch); its single→split degradation
+                    # lives in the model layer, so an error here means
+                    # even the split schedule failed on this lane — the
+                    # same breaker/cross-lane semantics as verify_fn
+                    ok = bool(current.verify_single_fn(sets))
                 else:
                     ok = bool(current.verify_fn(sets))
             if t0 and dispatched:
@@ -296,6 +322,7 @@ def single_lane_mesh(
     *,
     wedge_threshold: int = LANE_WEDGE_THRESHOLD,
     verify_prepared_fn: Callable | None = None,
+    verify_single_fn: Callable | None = None,
 ) -> VerifierMesh:
     """The pre-mesh shape: one lane, no sharded collective."""
     return VerifierMesh(
@@ -305,6 +332,7 @@ def single_lane_mesh(
                 verify_fn,
                 wedge_threshold=wedge_threshold,
                 verify_prepared_fn=verify_prepared_fn,
+                verify_single_fn=verify_single_fn,
             )
         ]
     )
@@ -330,15 +358,18 @@ def build_device_mesh(
     def _single() -> VerifierMesh:
         fn = fallback_verify_fn
         prepared_fn = None
+        single_fn = None
         if fn is None:
             try:
                 from lodestar_tpu.models.batch_verify import (
                     verify_prepared,
+                    verify_sets_single_launch,
                     verify_signature_sets_device,
                 )
 
                 fn = verify_signature_sets_device
                 prepared_fn = verify_prepared
+                single_fn = verify_sets_single_launch
             except Exception:
                 # a host without a usable jax stack (the standalone
                 # offload server historically served the pure-CPU
@@ -347,7 +378,10 @@ def build_device_mesh(
 
                 fn = verify_signature_sets
         return single_lane_mesh(
-            fn, wedge_threshold=wedge_threshold, verify_prepared_fn=prepared_fn
+            fn,
+            wedge_threshold=wedge_threshold,
+            verify_prepared_fn=prepared_fn,
+            verify_single_fn=single_fn,
         )
 
     if mode == "off":
@@ -369,6 +403,7 @@ def build_device_mesh(
                 bv.make_lane_verify_fn(i),
                 wedge_threshold=wedge_threshold,
                 verify_prepared_fn=bv.make_lane_verify_prepared_fn(i),
+                verify_single_fn=bv.make_lane_verify_single_fn(i),
             )
             for i in range(n)
         ]

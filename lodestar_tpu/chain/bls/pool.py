@@ -101,7 +101,10 @@ __all__ = [
 #: forces the overlap even on one chip (prep of batch k+1 interleaves
 #: with the verify of batch k on the same die — the host byte work and
 #: the prep launches slot into the verify program's gaps), "off" keeps
-#: prep inline with the launch.
+#: prep inline with the launch. Under --bls-single-launch the staged
+#: prep is host byte-parse only (the whole device chain is batch k's
+#: one launch), so the overlap is host parse of k+1 vs the single
+#: launch of k.
 PIPELINE_MODES = ("auto", "on", "off")
 
 # tuning constants — same values/rationale as the reference (index.ts:30-62)
@@ -292,17 +295,24 @@ class BlsDeviceVerifierPool(IBlsVerifier):
             )
         else:
             prepared_fn = None
+            single_fn = None
             if not explicit_fn:
-                # the default backend can verify staged inputs directly;
-                # an injected mock only speaks sets, so its lane leaves
-                # the prepared seam unset and mesh_launch re-preps inline
-                from lodestar_tpu.models.batch_verify import verify_prepared
+                # the default backend can verify staged inputs directly
+                # and serve the single-launch road; an injected mock
+                # only speaks sets, so its lane leaves both seams unset
+                # and mesh_launch re-preps inline through the mock
+                from lodestar_tpu.models.batch_verify import (
+                    verify_prepared,
+                    verify_sets_single_launch,
+                )
 
                 prepared_fn = verify_prepared
+                single_fn = verify_sets_single_launch
             self.mesh = single_lane_mesh(
                 verify_fn,
                 wedge_threshold=DEVICE_WEDGE_THRESHOLD,
                 verify_prepared_fn=prepared_fn,
+                verify_single_fn=single_fn,
             )
 
         # prep→verify double buffering: stage prep of package k+1 while
@@ -818,7 +828,12 @@ class BlsDeviceVerifierPool(IBlsVerifier):
     def pipeline_stats(self) -> dict:
         """Pipeline wall-clock accounting: prep/verify busy time, their
         overlap, the overlap share of verify time, and the staged
-        package count (0 = pipeline never engaged)."""
+        package count (0 = pipeline never engaged). The device path per
+        batch is either the split schedule (3-launch fused prep + the
+        RLC verify dispatch) or, under --bls-single-launch, ONE
+        resident program — in which case the prep accumulator measures
+        the staged host byte-parse and the verify accumulator the
+        single launch."""
         s = self._overlap.snapshot()
         v = s["verify_ns"]
         s["overlap_occupancy_pct"] = (100.0 * s["overlap_ns"] / v) if v else 0.0

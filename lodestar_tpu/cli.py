@@ -83,6 +83,20 @@ def _add_scheduler_args(sp) -> None:
         "placement, and the fail-closed degradation chain are unchanged.",
     )
     sp.add_argument(
+        # literal copy of models.batch_verify.SINGLE_LAUNCH_MODES
+        # (argparse-import doctrine: BeaconNodeOptions re-validates
+        # against the canonical tuple post-parse)
+        "--bls-single-launch", choices=["auto", "on", "off"], default="auto",
+        help="verify each BLS batch as ONE resident device program "
+        "(decompression, subgroup checks, hash-to-G2, RLC aggregation, "
+        "Miller loop, final exponentiation in a single counted "
+        "dispatch): auto = when the accelerator backend is live "
+        "(unless --bls-device-prep is pinned off), on = always, off = "
+        "the split prep-then-verify schedule. Single-"
+        "launch errors degrade per batch to the split schedule, then "
+        "host prep.",
+    )
+    sp.add_argument(
         "--htr-device", choices=["auto", "on", "off"], default="auto",
         help="flush state hashTreeRoot dirty subtrees through the device "
         "SHA-256 kernel (one batched launch per tree level): auto = only "
@@ -373,6 +387,7 @@ async def _run_dev(args) -> int:
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
             bls_pipeline=args.bls_pipeline,
+            bls_single_launch=args.bls_single_launch,
             htr_device=args.htr_device,
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,
@@ -543,6 +558,7 @@ async def _run_beacon(args) -> int:
             scheduler_enabled=not args.sched_disable,
             bls_device_prep=args.bls_device_prep,
             bls_pipeline=args.bls_pipeline,
+            bls_single_launch=args.bls_single_launch,
             htr_device=args.htr_device,
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,

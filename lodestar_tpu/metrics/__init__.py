@@ -373,11 +373,12 @@ class BlsPrepMetrics:
     native/python), prep wall time, device→host fallbacks and
     structurally-rejected batches."""
 
-    sets: Counter  # sets prepared, labeled by layer (device/host)
+    sets: Counter  # sets prepared, labeled by layer (device/host/single_launch)
     seconds: Histogram  # per-call prep wall time, labeled by layer
     fallbacks: Counter  # device-prep errors degraded to host prep
+    single_launch_fallbacks: Counter  # single-launch errors degraded to the split schedule
     rejected: Counter  # prep calls that rejected a structurally invalid batch
-    launches: Counter  # ALL device prep dispatches at ops/prep.py's dispatch seam
+    launches: Counter  # ALL dispatches at ops/prep.py's seam (prep legs AND single-launch verifies)
 
 
 @dataclass
@@ -534,16 +535,23 @@ def create_metrics() -> BeaconMetrics:
             "lodestar_bls_prep_fallback_total",
             "Device input-prep errors degraded to the host prep path",
         ),
+        single_launch_fallbacks=c.counter(
+            "lodestar_bls_single_launch_fallback_total",
+            "Single-launch verify errors (device fault or verdict-shape "
+            "anomaly) degraded to the split prep-then-verify schedule",
+        ),
         rejected=c.counter(
             "lodestar_bls_prep_rejected_total",
             "Prep calls that rejected a structurally invalid batch",
         ),
         launches=c.counter(
             "lodestar_bls_prep_launches_total",
-            "Device prep program dispatches (plain dispatch counter at the "
-            "ops/prep.py launch seam: fused-stage, per-leg, and "
-            "hash-to-G2 dispatches all count; the per-batch budget "
-            "invariant is asserted in tests against the same seam)",
+            "Device program dispatches at the ops/prep.py launch seam "
+            "(plain dispatch counter: fused-stage, per-leg, hash-to-G2 "
+            "AND single-launch verify dispatches all count — per-schedule "
+            "rates come from lodestar_device_launch_seconds{program}; the "
+            "per-batch budget invariant is asserted in tests against the "
+            "same seam)",
         ),
     )
     bls_pipeline = BlsPipelineMetrics(

@@ -48,23 +48,30 @@ from lodestar_tpu.ops import tower as tw
 
 __all__ = [
     "COEFF_BITS",
+    "SINGLE_LAUNCH_MODES",
+    "SingleLaunchInputs",
     "configure_device_prep",
+    "configure_single_launch",
     "consume_prep_info",
     "device_prep_active",
+    "single_launch_active",
     "prepare_sets",
     "prepare_sets_device",
+    "prepare_single_launch_inputs",
     "build_device_inputs",
     "device_batch_verify",
     "device_batch_verify_many",
     "device_batch_verify_sharded",
     "make_synthetic_sets",
     "verify_signature_sets_device",
+    "verify_sets_single_launch",
     "verify_prepared",
     "prepare_inputs_for_lane",
     "verify_signature_sets_sharded",
     "mesh_device_count",
     "make_lane_verify_fn",
     "make_lane_verify_prepared_fn",
+    "make_lane_verify_single_fn",
     "make_mesh_sharded_fn",
 ]
 
@@ -153,6 +160,74 @@ def _note_prep_fallback(err: Exception) -> None:
 
     get_logger(name="lodestar.bls-prep").warn(
         "device input prep failed, falling back to host prep",
+        {"error": str(err)[:120]},
+    )
+
+
+# --- single-launch verification (--bls-single-launch) -------------------------
+# The whole verification chain — field stage (decompression sqrt chains,
+# hash-to-field reduction, SSWU candidates), subgroup ladders, hash
+# finish + 3-isogeny, RLC aggregation, Miller loop, final exponentiation
+# — as ONE resident device program per pow-2 size class, dispatched once
+# through ops/prep.py's counted `_dispatch` seam
+# (`ops.prep.SINGLE_LAUNCH_BUDGET` == 1). "auto" engages when the
+# Pallas backend is live — the same doctrine as every other auto mode —
+# UNLESS the operator pinned device prep off: the single program
+# subsumes the prep stages, so an explicit host-prep pin keeps the
+# split schedule. (Prep "on" does NOT force single launch: that flag
+# is the tests'/benches' force-the-prep-stages knob.) Staged-jit
+# miscompile doctrine: the 3-launch fused prep + separate verify
+# dispatch is RETAINED as the differential reference, and a single-
+# launch device error (or verdict-shape anomaly) degrades that batch to
+# it — then to host prep inside build_device_inputs, exactly the
+# fused-vs-unfused chain.
+SINGLE_LAUNCH_MODES = ("auto", "on", "off")
+_single_launch_mode = "auto"  # guarded by: GIL (single str slot, set at node init / bench setup)
+
+
+def configure_single_launch(mode: str | None = None) -> str:
+    """Set the process-wide single-launch verification mode (node init;
+    tests/benches flip it around calls). Returns the PREVIOUS mode so
+    callers can save/restore."""
+    global _single_launch_mode
+    prev = _single_launch_mode
+    if mode is not None:
+        if mode not in SINGLE_LAUNCH_MODES:
+            raise ValueError(
+                f"bls_single_launch must be one of {SINGLE_LAUNCH_MODES}, got {mode!r}"
+            )
+        _single_launch_mode = mode
+    return prev
+
+
+def single_launch_active(mode: str | None = None) -> bool:
+    """Resolve a single-launch mode: "auto" engages when the Pallas
+    backend is live (the same doctrine as prep/mesh auto) UNLESS the
+    operator pinned device prep off — the single program subsumes the
+    prep stages, so an explicit host-prep pin keeps the split schedule.
+    Prep "on" does NOT implicitly engage single launch: it is the
+    tests'/benches' force-the-prep-stages knob and must keep meaning
+    exactly that."""
+    mode = mode or _single_launch_mode
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    if _prep_mode == "off":
+        return False
+    from lodestar_tpu.ops import fp_pallas
+
+    return fp_pallas.use_pallas()
+
+
+def _note_single_launch_fallback(err: Exception) -> None:
+    m = _prep_metrics
+    if m is not None:
+        m.single_launch_fallbacks.inc()
+    from lodestar_tpu.logger import get_logger
+
+    get_logger(name="lodestar.bls-prep").warn(
+        "single-launch verify failed, falling back to the split schedule",
         {"error": str(err)[:120]},
     )
 
@@ -247,6 +322,34 @@ def prepare_sets(sets: list[SignatureSet]):
     )
 
 
+def _parse_host_arrays(sets: list[SignatureSet], size: int):
+    """Host byte stage shared by the split and single-launch schedules:
+    wrong-length structural check, compressed-flag/limb parsing on
+    size-padded rows, expand_message_xmd reduction halves (padding rows
+    repeat row/message 0 and are masked by every consumer). Byte work
+    only — zero device dispatches; one source of truth so the two
+    schedules can't drift on the parse contract. Returns (pk_limbs,
+    pk_sign, pk_struct, sig_limbs, sig_sign, sig_struct, lo, hi), or
+    None when a set has a wrong-length encoding (a final structural
+    verdict, never a device error)."""
+    from lodestar_tpu.ops import prep as dp
+
+    n = len(sets)
+    if any(len(bytes(s.pubkey)) != 48 or len(bytes(s.signature)) != 96 for s in sets):
+        return None
+    pk_raw = np.frombuffer(
+        b"".join(bytes(s.pubkey) for s in sets), dtype=np.uint8
+    ).reshape(n, 48)
+    sig_raw = np.frombuffer(
+        b"".join(bytes(s.signature) for s in sets), dtype=np.uint8
+    ).reshape(n, 96)
+    msgs = [bytes(s.message) for s in sets]
+    pk_limbs, pk_sign, pk_struct = dp.parse_g1_compressed(dp.pad_rows(pk_raw, size))
+    sig_limbs, sig_sign, sig_struct = dp.parse_g2_compressed(dp.pad_rows(sig_raw, size))
+    lo, hi = dp.hash_to_field_limbs(msgs + [msgs[0]] * (size - n))
+    return pk_limbs, pk_sign, pk_struct, sig_limbs, sig_sign, sig_struct, lo, hi
+
+
 def _prepare_sets_device_arrays(sets: list[SignatureSet], size: int, fused: bool = True):
     """Device-resident prep on arrays padded to `size` (one compiled
     program per size class, same bucketing as the verify stages).
@@ -262,21 +365,12 @@ def _prepare_sets_device_arrays(sets: list[SignatureSet], size: int, fused: bool
     from lodestar_tpu.ops import prep as dp
 
     n = len(sets)
-    if any(len(bytes(s.pubkey)) != 48 or len(bytes(s.signature)) != 96 for s in sets):
+    parsed = _parse_host_arrays(sets, size)
+    if parsed is None:
         # wrong-length encodings are a structural reject, not a device
         # error — don't burn a host-fallback on garbage input
         return None, None, None, False
-    pk_raw = np.frombuffer(
-        b"".join(bytes(s.pubkey) for s in sets), dtype=np.uint8
-    ).reshape(n, 48)
-    sig_raw = np.frombuffer(
-        b"".join(bytes(s.signature) for s in sets), dtype=np.uint8
-    ).reshape(n, 96)
-    msgs = [bytes(s.message) for s in sets]
-
-    pk_limbs, pk_sign, pk_struct = dp.parse_g1_compressed(dp.pad_rows(pk_raw, size))
-    sig_limbs, sig_sign, sig_struct = dp.parse_g2_compressed(dp.pad_rows(sig_raw, size))
-    lo, hi = dp.hash_to_field_limbs(msgs + [msgs[0]] * (size - n))
+    pk_limbs, pk_sign, pk_struct, sig_limbs, sig_sign, sig_struct, lo, hi = parsed
 
     prep_arrays = dp.prepare_arrays_fused if fused else dp.prepare_arrays_unfused
     pk, pk_ok, sig, sig_ok, h = prep_arrays(
@@ -372,6 +466,61 @@ def _device_batch_verify_impl(pk_x, pk_y, h_x, h_y, sig_x, sig_y, coeff_bits, ma
 _stage_blind_and_aggregate = jax.jit(_blind_and_aggregate_body)
 _stage_miller = jax.jit(lambda p_x, p_y, q_x, q_y: prg.miller_loop((p_x, p_y), (q_x, q_y)))
 _stage_fold_verdict = jax.jit(_fold_verdict_body)
+
+
+@jax.jit
+def _single_launch_verify(
+    pk_x_std, pk_sign, sig_x_std, sig_sign, lo, hi, struct_ok, coeff_bits, mask
+):
+    """THE single-launch program: compressed-point limbs + hash-to-field
+    halves in, scalar verdict out — one resident device program per
+    pow-2 size class (`ops.prep.SINGLE_LAUNCH_BUDGET` dispatches per
+    batch, counted at ops/prep.py's `_dispatch` seam).
+
+    Composed by CALLING the fused schedule's three staged legs
+    (ops/prep.py `_prep_field_stage` / `_prep_subgroup_stage` /
+    `hash_finish` — jitted functions inline inside an outer jit, so the
+    single program and the 3-launch reference share one source of truth
+    per leg) plus the RLC/pairing bodies of this module; the G2 ladder
+    tables and hot curve constants are closed over as jit constants, so
+    they stay pinned in device memory across batches. Structurally
+    invalid rows (host parse flags in `struct_ok`, on-curve/subgroup
+    flags decided here) fold into the verdict on device: any invalid
+    unmasked row makes the batch False, exactly the fail-fast the split
+    schedule applies before its verify dispatch. Returns
+    (verdict, batch_valid) scalar bools — the second distinguishes a
+    structural reject from an invalid signature for the prep-rejection
+    metric only (both are final False verdicts)."""
+    from lodestar_tpu.ops import prep as dp
+
+    # the fused schedule's three legs, one trace: field stage
+    # (decompression chains + the shared Fp2 sqrt chain + SSWU +
+    # 3-isogeny), subgroup ladders, hash finish (add + Budroni–Pintore
+    # clearing + batch affine)
+    pk_x, pk_y, pk_curve, sig_x, sig_y, sig_curve, q0, q1 = dp._prep_field_stage(
+        pk_x_std, pk_sign, sig_x_std, sig_sign, lo, hi
+    )
+    pk_ok, sig_ok = dp._prep_subgroup_stage(
+        pk_x, pk_y, pk_curve, sig_x, sig_y, sig_curve
+    )
+    h_x, h_y = dp.hash_finish(q0, q1)
+
+    # RLC aggregation + Miller loop + final exponentiation. Invalid rows
+    # carry in-contract relaxed limbs (the pow-chain outputs), so the
+    # group ops below stay well-defined on them; their garbage pairing
+    # values are irrelevant because `batch_valid` vetoes the verdict.
+    rpk_aff, s_aff, s_inf = _blind_and_aggregate_body(
+        pk_x, pk_y, sig_x, sig_y, coeff_bits, mask
+    )
+    p_x, p_y, q_x, q_y, pair_mask = _assemble_pairs(
+        rpk_aff, s_aff, s_inf, h_x, h_y, mask
+    )
+    fs = prg.miller_loop((p_x, p_y), (q_x, q_y))
+    rlc_ok = _fold_verdict_body(fs, pair_mask)
+
+    valid = struct_ok & pk_ok & sig_ok
+    batch_valid = jnp.all(valid | ~mask)
+    return batch_valid & rlc_ok, batch_valid
 
 
 def _device_batch_verify_staged(pk, h, sig, coeff_bits, mask):
@@ -673,13 +822,22 @@ def _random_coeffs(n: int) -> np.ndarray:
     return out
 
 
-def _finish_inputs(pk, h, sig, n: int, size: int):
-    """Fresh blinding bits + padding mask over size-padded point arrays."""
-    coeffs = _random_coeffs(n)
+def _blinding_and_mask(n: int, size: int):
+    """Fresh RLC blinding bits + padding mask for a size-padded batch —
+    the soundness-critical tail (coeff 0 fixed to 1, the rest nonzero
+    64-bit; padding rows zeroed and masked out) shared by BOTH device
+    schedules: the split path's `_finish_inputs` and the single-launch
+    host stage, so the blinding contract can't drift between them."""
     bits = np.zeros((size, COEFF_BITS), dtype=np.int32)
-    bits[:n] = _bits_msb(coeffs, COEFF_BITS)
+    bits[:n] = _bits_msb(_random_coeffs(n), COEFF_BITS)
     mask = np.zeros(size, dtype=bool)
     mask[:n] = True
+    return bits, mask
+
+
+def _finish_inputs(pk, h, sig, n: int, size: int):
+    """Fresh blinding bits + padding mask over size-padded point arrays."""
+    bits, mask = _blinding_and_mask(n, size)
     return pk, h, sig, bits, mask
 
 
@@ -748,7 +906,23 @@ def make_synthetic_sets(n: int, seed: int = 1) -> list[SignatureSet]:
 
 
 def verify_signature_sets_device(sets: list[SignatureSet]) -> bool:
-    """End-to-end single-device batch verify of N signature sets."""
+    """End-to-end single-device batch verify of N signature sets.
+
+    Routes through the single-launch program when `--bls-single-launch`
+    resolves active (one counted dispatch, bytes-in → verdict-out, with
+    its own degradation chain back to the split schedule); otherwise
+    runs the split schedule: 3-launch fused device prep (or host prep)
+    followed by the RLC verify dispatch."""
+    if single_launch_active():
+        return verify_sets_single_launch(sets)
+    return _verify_sets_split(sets)
+
+
+def _verify_sets_split(sets: list[SignatureSet]) -> bool:
+    """The split (prep-then-verify) schedule: `build_device_inputs`
+    (fused 3-launch device prep, host prep on error or by mode) plus
+    the separate RLC verify dispatch — the single-launch program's
+    differential reference and per-batch fallback."""
     inputs = build_device_inputs(sets)
     if inputs is None:
         return False
@@ -756,12 +930,112 @@ def verify_signature_sets_device(sets: list[SignatureSet]) -> bool:
     return bool(np.asarray(device_batch_verify(pk, h, sig, bits, mask)))
 
 
+class SingleLaunchInputs:
+    """Host-staged inputs for one single-launch dispatch: the parsed
+    limb/flag/hash arrays, fresh blinding bits, and the padding mask —
+    everything `_single_launch_verify` consumes, produced by byte work
+    only (no device dispatches). Carries the original sets so the
+    verify side can degrade to the split schedule on a device error."""
+
+    __slots__ = ("sets", "arrays", "bits", "mask", "n")
+
+    def __init__(self, sets, arrays, bits, mask, n):
+        self.sets = sets
+        self.arrays = arrays  # (pk_limbs, pk_sign, sig_limbs, sig_sign, lo, hi, struct)
+        self.bits = bits
+        self.mask = mask
+        self.n = n
+
+
+def prepare_single_launch_inputs(sets: list[SignatureSet]):
+    """Host byte stage of the single-launch path: compressed-flag
+    parsing, limb unpacking, expand_message_xmd, blinding sampling —
+    zero device dispatches. Returns SingleLaunchInputs, or None when a
+    set is structurally rejected at parse time (wrong-length encoding:
+    a final verdict, never a launch — the pipelined pool stages this
+    reject without touching the device)."""
+    if not sets:
+        return None
+    n = len(sets)
+    t0 = time.monotonic_ns()
+    size = _pad_pow2(n)
+    parsed = _parse_host_arrays(sets, size)
+    if parsed is None:
+        _note_prep("single_launch", n, t0, rejected=True)
+        return None
+    pk_limbs, pk_sign, pk_struct, sig_limbs, sig_sign, sig_struct, lo, hi = parsed
+    struct = pk_struct & sig_struct
+    bits, mask = _blinding_and_mask(n, size)
+    _note_prep("single_launch", n, t0)
+    return SingleLaunchInputs(
+        list(sets), (pk_limbs, pk_sign, sig_limbs, sig_sign, lo, hi, struct), bits, mask, n
+    )
+
+
+def _verify_single_prepared(si: SingleLaunchInputs) -> bool:
+    """Dispatch ONE single-launch program on host-staged inputs. A
+    device error or a verdict-shape anomaly degrades the batch to the
+    split schedule (counted + warned) — which itself degrades device
+    prep to host prep, the full staged-jit miscompile chain."""
+    from lodestar_tpu.ops import prep as dp
+
+    try:
+        verdict, batch_valid = dp._dispatch(
+            _single_launch_verify, *si.arrays, si.bits, si.mask
+        )
+        # BOTH outputs are shape-checked inside the guarded region: a
+        # miscompile returning a malformed batch_valid must degrade
+        # like any other anomaly, not raise into the lane/breaker
+        v = np.asarray(verdict)
+        bvld = np.asarray(batch_valid)
+        for name, arr in (("verdict", v), ("batch_valid", bvld)):
+            if arr.shape != () or arr.dtype != np.bool_:
+                raise RuntimeError(
+                    f"single-launch {name} shape anomaly: {arr.shape}/{arr.dtype}"
+                )
+    except Exception as e:  # degrade to the split schedule, never resolve here
+        _note_single_launch_fallback(e)
+        return _verify_sets_split(si.sets)
+    if not bool(bvld):
+        m = _prep_metrics
+        if m is not None:
+            m.rejected.inc()
+    return bool(v)
+
+
+def verify_sets_single_launch(sets: list[SignatureSet]) -> bool:
+    """End-to-end single-launch batch verify: compressed bytes in, ONE
+    counted device dispatch (`ops.prep.SINGLE_LAUNCH_BUDGET`), verdict
+    out — verdicts identical to `verify_signature_sets_device` on the
+    same sets. Host-parse rejects cost zero dispatches; device errors
+    degrade per-batch to the split schedule."""
+    try:
+        si = prepare_single_launch_inputs(sets)
+    except Exception as e:
+        # a host-parse ERROR (not a structural reject) degrades to the
+        # split schedule like any other single-launch fault — the split
+        # path catches the same class inside build_device_inputs and
+        # lands on host prep, so a poisoned batch can never raise out
+        # of here and charge every lane's breaker in turn
+        _note_single_launch_fallback(e)
+        return _verify_sets_split(sets)
+    if si is None:
+        return False
+    return _verify_single_prepared(si)
+
+
 def verify_prepared(inputs) -> bool:
-    """Verify a batch whose inputs were already staged by
-    `build_device_inputs` — the second half of the prep→verify pipeline
-    (chain/bls/pool.py double-buffers prep of batch k+1 against this
-    call on batch k). Blinding was sampled at prep time; the verdict is
+    """Verify a batch whose inputs were already staged by the pipeline's
+    prep stage (chain/bls/pool.py double-buffers prep of batch k+1
+    against this call on batch k). Two staged shapes: the split
+    schedule's `build_device_inputs` tuple (device arrays; blinding
+    sampled at prep time; one RLC verify dispatch here), or a
+    `SingleLaunchInputs` (host byte-parse only; the ONE single-launch
+    program dispatches here, so the whole device chain of batch k
+    overlaps the host parse of batch k+1). Either way the verdict is
     identical to `verify_signature_sets_device` on the same sets."""
+    if isinstance(inputs, SingleLaunchInputs):
+        return _verify_single_prepared(inputs)
     pk, h, sig, bits, mask = inputs
     return bool(np.asarray(device_batch_verify(pk, h, sig, bits, mask)))
 
@@ -771,7 +1045,16 @@ def prepare_inputs_for_lane(sets: list[SignatureSet], lane_index: int | None = N
     a sibling chip (`jax.default_device`) so staging batch k+1 doesn't
     contend with the lane verifying batch k. A hint that doesn't resolve
     to a device (mock lanes, single-device hosts) preps unpinned —
-    placement is an optimization, never a correctness seam."""
+    placement is an optimization, never a correctness seam.
+
+    With single-launch verification active the prep stage stays on the
+    HOST (byte parse + xmd + blinding, zero dispatches): every device
+    op of batch k+1 rides its one launch, so the pipeline overlaps the
+    host byte-parse/reject of k+1 with the single launch of k. A
+    parse-time structural reject stages None — a final verdict, still
+    not a launch."""
+    if single_launch_active():
+        return prepare_single_launch_inputs(sets)
     if lane_index is not None:
         try:
             dev = jax.devices()[lane_index]
@@ -828,7 +1111,9 @@ def make_lane_verify_prepared_fn(device_index: int):
     """Prepared-inputs twin of `make_lane_verify_fn`: the pipelined
     pool's verify stage, pinned to one chip. Inputs staged on a sibling
     device transfer on first use (jax moves committed arrays); the
-    verdict is placement-independent."""
+    verdict is placement-independent. Handles both staged shapes
+    (split-schedule device arrays and host-parsed SingleLaunchInputs —
+    see verify_prepared)."""
 
     def lane_verify_prepared(inputs) -> bool:
         dev = jax.devices()[device_index]
@@ -837,6 +1122,23 @@ def make_lane_verify_prepared_fn(device_index: int):
 
     lane_verify_prepared.__name__ = f"lane_verify_prepared_dev{device_index}"
     return lane_verify_prepared
+
+
+def make_lane_verify_single_fn(device_index: int):
+    """Single-launch twin of `make_lane_verify_fn`, pinned to one chip:
+    the mesh pool's unstaged verify road when `--bls-single-launch`
+    resolves active — each lane keeps its own compiled copy of the one
+    resident program on its die. Degradation (single-launch error →
+    split schedule → host prep) rides inside, so lane/breaker error
+    semantics are unchanged."""
+
+    def lane_verify_single(sets: list[SignatureSet]) -> bool:
+        dev = jax.devices()[device_index]
+        with jax.default_device(dev):
+            return verify_sets_single_launch(sets)
+
+    lane_verify_single.__name__ = f"lane_verify_single_dev{device_index}"
+    return lane_verify_single
 
 
 def make_mesh_sharded_fn():

@@ -55,6 +55,7 @@ class BeaconNodeOptions:
         scheduler_enabled: bool = True,
         bls_device_prep: str = "auto",
         bls_pipeline: str = "auto",
+        bls_single_launch: str = "auto",
         htr_device: str = "auto",
         bls_mesh: str = "auto",
         offload_tenant: str | None = None,
@@ -157,6 +158,22 @@ class BeaconNodeOptions:
                 f"bls_pipeline must be one of {PIPELINE_MODES}, got {bls_pipeline!r}"
             )
         self.bls_pipeline = bls_pipeline
+        # single-launch verification (models/batch_verify.py): "auto"
+        # verifies each batch as ONE resident device program when the
+        # Pallas backend is live (an explicit device-prep "off" pin
+        # keeps the split schedule); "on"/"off" force. Single-launch
+        # errors degrade per batch to the split prep-then-verify
+        # schedule, then host prep. Validated against the model layer's
+        # canonical mode set (cli.py keeps a literal copy — argparse
+        # choices must not import jax)
+        from lodestar_tpu.models.batch_verify import SINGLE_LAUNCH_MODES
+
+        if bls_single_launch not in SINGLE_LAUNCH_MODES:
+            raise ValueError(
+                f"bls_single_launch must be one of {SINGLE_LAUNCH_MODES}, "
+                f"got {bls_single_launch!r}"
+            )
+        self.bls_single_launch = bls_single_launch
         # state hashTreeRoot placement (ssz/device_htr.py collector):
         # "auto" flushes dirty subtrees through the device SHA-256
         # kernel only when the Pallas backend is live; "on"/"off" force.
@@ -303,9 +320,16 @@ class BeaconNode:
         # 2d. batch-verify input prep placement + lodestar_bls_prep_*
         # metrics: process-global like the tracer (the prep runs inside
         # the model layer, below any node object)
-        from lodestar_tpu.models.batch_verify import configure_device_prep
+        from lodestar_tpu.models.batch_verify import (
+            configure_device_prep,
+            configure_single_launch,
+        )
 
         configure_device_prep(mode=opts.bls_device_prep, metrics=metrics.bls_prep)
+        # single-launch verification mode rides the same process-global
+        # seam (the router lives in the model layer, below any node
+        # object); metrics are shared with the prep family above
+        configure_single_launch(mode=opts.bls_single_launch)
 
         # 2e. state hashTreeRoot placement + lodestar_ssz_htr_* metrics:
         # process-global like the prep mode (the collector runs inside

@@ -363,6 +363,16 @@ def main() -> int:
         "than one device is visible); off = CPU oracle backend",
     )
     ap.add_argument(
+        # literal copy of models.batch_verify.SINGLE_LAUNCH_MODES
+        # (argparse-import doctrine: re-validated by configure below)
+        "--bls-single-launch", choices=["auto", "on", "off"], default="auto",
+        help="verify each served batch as ONE resident device program "
+        "(see the node flag of the same name): auto = when the Pallas "
+        "backend is live, on = always, off = pin the split "
+        "prep-then-verify schedule — the serving-host knob for "
+        "avoiding the monolithic program's first-use compile",
+    )
+    ap.add_argument(
         "--tenant-weight", action="append", default=[], metavar="NAME=WEIGHT",
         help="stride-fair service share for a tenant (repeatable); unlisted "
         "tenants get --tenant-default-weight",
@@ -390,6 +400,25 @@ def main() -> int:
     chip_status_fn = None
     backend = verify_signature_sets
     if args.bls_mesh != "off":
+        # the mesh lanes route through the process-global single-launch
+        # mode (models/batch_verify); pin it from the server's own flag
+        # so a serving host is never one env change away from a surprise
+        # first-use compile of the monolithic program. Inside the mesh
+        # branch on purpose: a --bls-mesh off server keeps the CPU
+        # oracle backend, which never consults the mode — pinning it
+        # would pay the whole jax/model import at startup for nothing
+        try:
+            from lodestar_tpu.models.batch_verify import configure_single_launch
+        except ImportError:
+            # a host without a usable jax stack serves the CPU oracle
+            # (same doctrine as build_device_mesh's fallback import) —
+            # there is no single-launch program to configure. Import
+            # errors ONLY: a ValueError from configure (the literal
+            # argparse copy drifting from SINGLE_LAUNCH_MODES) must be
+            # a loud startup failure exactly as on the node path
+            pass
+        else:
+            configure_single_launch(mode=args.bls_single_launch)
         # serve the mesh synchronously: mesh_launch keeps the per-chip
         # wedge accounting + cross-lane error retry (a sick chip trips
         # ITS breaker, drops out of the advertised chip table, and

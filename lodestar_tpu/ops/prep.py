@@ -81,6 +81,7 @@ __all__ = [
     "prepare_arrays_unfused",
     "FUSED_PREP_LAUNCHES",
     "UNFUSED_PREP_LAUNCHES",
+    "SINGLE_LAUNCH_BUDGET",
 ]
 
 P = F.P
@@ -163,6 +164,13 @@ FUSED_PREP_LAUNCHES = 3
 #: the pre-fusion schedule: one launch per pipeline leg (G1 decompress,
 #: G2 decompress, wide reduction, SSWU map, hash finish).
 UNFUSED_PREP_LAUNCHES = 5
+#: dispatch budget of one `verify_sets_single_launch` batch
+#: (models/batch_verify.py): the WHOLE verification chain — field stage,
+#: subgroup ladders, hash finish, RLC aggregation, Miller loop, final
+#: exponentiation — as one resident program, bytes-in → verdict-out.
+#: Independent of batch size; the 3-launch fused prep + separate verify
+#: dispatch stays as the differential reference and per-batch fallback.
+SINGLE_LAUNCH_BUDGET = 1
 
 
 def configure_launch_counter(counter) -> None:

@@ -11,3 +11,5 @@ def report(name_var, n_dev):
     _line(f"gated_family_{n_dev}dev", 3.0, "ops", 1.0)  # clean: pattern gated
     _line(f"orphan_family_{n_dev}dev", 4.0, "ops", 1.0)  # BAD: pattern gates nothing
     _line(name_var, 5.0, "ops", 1.0)  # BAD: not statically derivable
+    _line("budget_launches_per_batch", 1.0, "launches/batch", 1.0)  # reported; direction is the bug
+    _line("budget_launches_per_batch_split", 4.0, "launches/batch", 1.0)  # suffixed variant; same bug

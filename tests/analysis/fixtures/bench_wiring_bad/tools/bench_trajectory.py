@@ -4,6 +4,8 @@ THRESHOLDS = {
     "gated_line_per_sec": 0.5,
     "gated_family_2dev": 0.5,
     "ghost_metric_per_sec": 0.5,  # BAD: nobody reports this line
+    "budget_launches_per_batch": 0.05,  # BAD: launch-budget line, not lower-is-better
+    "budget_launches_per_batch_split": 0.05,  # BAD: suffixed variant must not evade the check
 }
 
 LOWER_IS_BETTER = {

@@ -9,3 +9,4 @@ def report(n_dev, suffix):
     _line("gated_line_per_sec", 1.0, "ops", 1.0)
     _line(f"gated_family_{n_dev}dev", 3.0, "ops", 1.0)
     _line(f"replay_sigs_per_sec{suffix}", 4.0, "sigs/s", 1.0)  # suffix may be ""
+    _line("budget_launches_per_batch", 1.0, "launches/batch", 1.0)

@@ -6,8 +6,10 @@ THRESHOLDS = {
     "replay_sigs_per_sec": 0.5,
     "replay_sigs_per_sec_device": 0.5,
     "headline_per_sec": 0.5,
+    "budget_launches_per_batch": 0.05,  # launch-budget line, correctly lower-is-better
 }
 
 LOWER_IS_BETTER = {
     "gated_line_per_sec",
+    "budget_launches_per_batch",
 }

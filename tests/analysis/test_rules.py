@@ -337,9 +337,19 @@ def test_bench_wiring_flags_every_gap_class():
     assert "not a literal or f-string" in joined
     # direction-set hygiene
     assert "'never_a_threshold_ms' is not a THRESHOLDS key" in joined
+    # launch-budget line not in LOWER_IS_BETTER: gating in the wrong direction
+    assert (
+        "'budget_launches_per_batch' is a launch-budget line but not a "
+        "LOWER_IS_BETTER member" in joined
+    )
+    # a suffixed variant tail must not evade the budget-direction check
+    assert (
+        "'budget_launches_per_batch_split' is a launch-budget line but not a "
+        "LOWER_IS_BETTER member" in joined
+    )
     # the gated literal and the gated family pattern stay quiet
     assert "gated_line_per_sec" not in joined or "'gated_line_per_sec' names no" not in joined
-    assert len(msgs) == 5, joined
+    assert len(msgs) == 7, joined
 
 
 def test_bench_wiring_clean_tree():

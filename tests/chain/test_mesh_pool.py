@@ -411,8 +411,16 @@ def test_mesh_launch_shared_core_wedges_and_routes_around_sick_chip():
     rig.kill(0)
     wedges = []
     for i in range(6):
+        # prefer the sick lane so every round deterministically attempts
+        # it until its breaker trips — the default least-occupied pick is
+        # wall-clock EWMA and under a loaded container can route around
+        # the sick lane WITHOUT wedging it, which is healthy routing but
+        # not the accounting this test pins
         ok, lane = mesh_launch(
-            rig.mesh, _sets(1, tag=i), on_wedge=lambda l: wedges.append(l.index)
+            rig.mesh,
+            _sets(1, tag=i),
+            prefer=rig.mesh.lanes[0],
+            on_wedge=lambda l: wedges.append(l.index),
         )
         assert ok and lane.index == 1
         if rig.mesh.lanes[0].wedged:

@@ -278,6 +278,46 @@ def test_auto_single_lane_keeps_pre_pipeline_schedule():
     assert stats_auto["prep_ns"] == 0  # the prep stage never ran
 
 
+def test_auto_single_launch_keeps_pre_pipeline_schedule():
+    """Satellite regression (the PR 9 shape, round 13): 1-lane
+    `--bls-single-launch auto` + `--bls-pipeline auto` keeps schedule
+    equality with the pipeline off — zero staged packages, identical
+    launch sequence. On this container single-launch auto resolves OFF
+    (it follows device prep auto, and the Pallas backend is dead), so
+    the default pool must be bit-identical to the pre-single-launch
+    schedule."""
+    from lodestar_tpu.models import batch_verify as bv
+
+    def replay(pipeline: str):
+        rig = FakeLaneRig(1, call_s=0.01, with_sharded=False)
+
+        async def go():
+            pool = BlsDeviceVerifierPool(
+                mesh=rig.mesh, scheduler_enabled=True, pipeline=pipeline
+            )
+            assert pool.pipeline_stats()["pipeline_enabled"] is False
+            for i in range(4):
+                assert await pool.verify_signature_sets(
+                    _sets(1, tag=i), VerifySignatureOpts(batchable=False)
+                )
+            stats = pool.pipeline_stats()
+            await pool.close()
+            return rig.calls, stats
+
+        return _run(go())
+
+    prev = bv.configure_single_launch(mode="auto")
+    try:
+        assert bv.single_launch_active() is False  # auto = off without Pallas
+        calls_auto, stats_auto = replay("auto")
+        calls_off, stats_off = replay("off")
+    finally:
+        bv.configure_single_launch(mode=prev)
+    assert calls_auto == calls_off
+    assert stats_auto["staged_packages"] == 0 == stats_off["staged_packages"]
+    assert stats_auto["prep_ns"] == 0
+
+
 # -- mode wiring ---------------------------------------------------------------
 
 

@@ -1,8 +1,9 @@
 """Launch telemetry (lodestar_tpu/telemetry.py): ledger determinism and
 bounds, first-call compile detection per (program, size class), mode
-semantics, the metric sink, and the three counted dispatch seams
-actually landing in the histogram — fused prep (3-launch schedule),
-HTR per-level dispatches, and mesh lane launches."""
+semantics, the metric sink, and the counted dispatch seams actually
+landing in the histogram — fused prep (3-launch schedule), the
+single-launch verification program (exactly one record per batch), HTR
+per-level dispatches, and mesh lane launches."""
 
 from __future__ import annotations
 
@@ -238,6 +239,47 @@ class TestPrepSeam:
             "map_to_g2_jac",
             "hash_finish",
         ]
+
+
+# -- seam: single-launch verification (one record per batch) --------------------
+
+
+class TestSingleLaunchSeam:
+    @pytest.mark.slow  # compiles the real single-launch program (~40 s
+    # XLA compile on the CPU container — over tier-1's remaining budget)
+    def test_one_record_per_batch_with_program_and_size_class(self, tel):
+        """A `--bls-single-launch on` verified batch lands in the ledger
+        as EXACTLY one record carrying the program's own name and the
+        pow-2 size class, independent of batch size; compile-miss is
+        counted once per (program, size_class); the slow-slot dump
+        names it."""
+        from lodestar_tpu.models import batch_verify as bv
+        from lodestar_tpu.ops import prep
+
+        probe = _Probe()
+        tel.configure_launch_telemetry(metrics=probe)
+        prev = bv.configure_single_launch(mode="on")
+        try:
+            for n in (2, 3):
+                base = len(tel.launch_ledger())
+                assert bv.verify_sets_single_launch(
+                    bv.make_synthetic_sets(n, seed=n + 60)
+                )
+                entries = tel.launch_ledger()[base:]
+                assert len(entries) == prep.SINGLE_LAUNCH_BUDGET == 1
+                e = entries[0]
+                assert e["program"] == "_single_launch_verify"
+                assert e["size_class"] == 8  # both batches share the pow-2 class
+        finally:
+            bv.configure_single_launch(mode=prev)
+        # compile-miss once per (program, size_class): first batch miss,
+        # second batch hit — the jit cache holds one executable per key
+        misses = [m for m in probe.compile_misses.events if m[1] == ("_single_launch_verify",)]
+        hits = [h for h in probe.compile_hits.events if h[1] == ("_single_launch_verify",)]
+        assert len(misses) == 1 and len(hits) == 1
+        # the launch ledger + slow-slot dumps name the program
+        view = tel.slow_slot_launches()
+        assert any(r.startswith("_single_launch_verify/8 ") for r in view["recent"])
 
 
 # -- seam: device HTR per-level dispatches --------------------------------------

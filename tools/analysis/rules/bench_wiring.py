@@ -30,6 +30,13 @@ Checks:
 3. **direction hygiene**: every ``LOWER_IS_BETTER`` member must be a
    ``THRESHOLDS`` key — a direction flag for a nonexistent metric is
    dead configuration.
+4. **launch-budget direction**: a ``THRESHOLDS`` key naming a
+   launch-budget line (``*_launches_per_batch*`` / ``*_launches_per_set*``,
+   variant tails like ``_split``/``_unfused`` included) must be a
+   ``LOWER_IS_BETTER`` member — more launches is the regression, and a
+   budget line silently gating in the higher-is-better direction would
+   PASS a schedule that grew a launch and FAIL the next round that
+   removed one.
 """
 
 from __future__ import annotations
@@ -46,6 +53,14 @@ TRAJECTORY_REL = Path("tools") / "bench_trajectory.py"
 REPORT_FN = "_line"
 THRESHOLDS_NAME = "THRESHOLDS"
 DIRECTION_NAME = "LOWER_IS_BETTER"
+#: metric-name markers that denote a launch-budget line (a dispatch
+#: count, where MORE is the regression) — these must gate
+#: lower-is-better. Matched ANYWHERE in the key, not as an exact
+#: suffix: variant tails are an active naming pattern
+#: ("prep_launches_per_set_unfused", "e2e_launches_per_batch_split")
+#: and a suffixed budget line evading the check would gate a grown
+#: launch as an improvement.
+LAUNCH_BUDGET_MARKERS = ("_launches_per_batch", "_launches_per_set")
 
 
 def _parse(path: Path):
@@ -244,6 +259,19 @@ class BenchWiringRule(Rule):
                         self.name, str(traj_path), line,
                         f"{DIRECTION_NAME} member '{member}' is not a "
                         f"{THRESHOLDS_NAME} key — dead direction flag",
+                    )
+                )
+        # launch-budget direction: a dispatch-count line gating
+        # higher-is-better would pass a schedule that GREW a launch
+        for key, line in sorted(thresholds.items()):
+            if any(m in key for m in LAUNCH_BUDGET_MARKERS) and key not in direction:
+                findings.append(
+                    Finding(
+                        self.name, str(traj_path), line,
+                        f"{THRESHOLDS_NAME} entry '{key}' is a "
+                        f"launch-budget line but not a {DIRECTION_NAME} "
+                        "member — it would gate in the wrong direction "
+                        "(more launches must be the regression)",
                     )
                 )
         return findings

@@ -45,18 +45,34 @@ def _line(metric, value, unit, vs, digits=1):
     }), flush=True)
 
 
-def config2_gossip_replay(device_prep: bool = False):
-    """Per-slot gossip attestation load through the production pool.
+def config2_gossip_replay(device_prep: bool = False, single_launch: bool = False):
+    """Per-slot gossip attestation load through the production pool —
+    one replay harness, three reported lines (same n/jobs/warm-up, so
+    the comparands can't drift apart).
 
     With device_prep=True the whole per-set input pipeline (decompress +
     subgroup + hash-to-G2) runs on-chip (`--bls-device-prep on`); the
     prep-off run is the PERF.md r5 396.5 sigs/s baseline shape where one
-    host core feeds the device."""
+    host core feeds the device. Both of those are split-schedule
+    reference lines (the comparands of
+    `single_launch_replay_sigs_per_sec`), so single-launch is pinned
+    OFF — on a Pallas host the auto mode would otherwise route the pool
+    through the one-launch program and the line would measure the
+    schedule it is the reference against. With single_launch=True the
+    whole verification chain of every package is ONE resident program
+    (`--bls-single-launch on`; device prep stays at its ambient mode —
+    the prep stages only serve that run's fault-fallback leg), reported
+    as `single_launch_replay_sigs_per_sec` — the line to read against
+    `gossip_replay_sigs_per_sec_device_prep`."""
     import asyncio
 
     from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
     from lodestar_tpu.chain.bls.pool import BlsDeviceVerifierPool
-    from lodestar_tpu.models.batch_verify import configure_device_prep, make_synthetic_sets
+    from lodestar_tpu.models.batch_verify import (
+        configure_device_prep,
+        configure_single_launch,
+        make_synthetic_sets,
+    )
 
     n = 1024 if QUICK else 4096
     sets = make_synthetic_sets(n, seed=31)
@@ -79,11 +95,19 @@ def config2_gossip_replay(device_prep: bool = False):
         await pool.close()
         return n / dt
 
-    prev = configure_device_prep(mode="on" if device_prep else "off")
+    prev = configure_device_prep(
+        mode=None if single_launch else ("on" if device_prep else "off")
+    )
+    prev_single = configure_single_launch(mode="on" if single_launch else "off")
     try:
         rate = asyncio.run(run())
     finally:
+        configure_single_launch(mode=prev_single)
         configure_device_prep(mode=prev)
+    if single_launch:
+        _line("single_launch_replay_sigs_per_sec", rate, "sigs/s",
+              rate / REFERENCE_SIGS_PER_SEC_PER_CORE)
+        return
     suffix = "_device_prep" if device_prep else ""
     _line(f"gossip_replay_sigs_per_sec{suffix}", rate, "sigs/s",
           rate / REFERENCE_SIGS_PER_SEC_PER_CORE)
@@ -301,16 +325,57 @@ def prep_launch_fusion():
     )
 
 
+def single_launch_schedule():
+    """End-to-end launch count per verified batch: the single-launch
+    resident program (`--bls-single-launch on`, ONE counted dispatch)
+    vs the split reference (3-launch fused prep + the RLC verify
+    dispatch), both counted at the telemetry seam — the dispatch-budget
+    invariant the chip run's launch dashboard reads."""
+    from lodestar_tpu import telemetry
+    from lodestar_tpu.models import batch_verify as bv
+
+    n = 32
+    sets = bv.make_synthetic_sets(n, seed=53)
+    prev_tel = telemetry.configure_launch_telemetry(mode="on")
+    prev_prep = bv.configure_device_prep(mode="on")
+    try:
+        counts = {}
+        for fn, name in (
+            (bv.verify_sets_single_launch, "e2e_launches_per_batch"),
+            (bv._verify_sets_split, "e2e_launches_per_batch_split"),
+        ):
+            if not fn(sets):  # warm the compiled program(s)
+                raise RuntimeError(f"{name} bench rejected valid sets")
+            base = telemetry.launch_totals()["launches"]
+            if not fn(sets):
+                raise RuntimeError(f"{name} bench rejected valid sets")
+            counts[name] = telemetry.launch_totals()["launches"] - base
+    finally:
+        bv.configure_device_prep(mode=prev_prep)
+        telemetry.configure_launch_telemetry(mode=prev_tel)
+    split = counts["e2e_launches_per_batch_split"]
+    _line("e2e_launches_per_batch", counts["e2e_launches_per_batch"],
+          "launches/batch", counts["e2e_launches_per_batch"] / split)
+    _line("e2e_launches_per_batch_split", split, "launches/batch", 1.0)
+
+
 def config2_gossip_replay_pipelined():
     """Config-2 gossip replay with the prep→verify pipeline ON (1-lane
     interleave on this container) and device prep on — the line to read
     against gossip_replay_sigs_per_sec_device_prep — plus the measured
-    fraction of verify wall time with a prep stage in flight."""
+    fraction of verify wall time with a prep stage in flight.
+    Single-launch is pinned OFF like its comparand: this line measures
+    the SPLIT pipeline (3-launch staged prep overlapping the verify
+    dispatch), not the single-launch host-parse overlap."""
     import asyncio
 
     from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
     from lodestar_tpu.chain.bls.pool import BlsDeviceVerifierPool
-    from lodestar_tpu.models.batch_verify import configure_device_prep, make_synthetic_sets
+    from lodestar_tpu.models.batch_verify import (
+        configure_device_prep,
+        configure_single_launch,
+        make_synthetic_sets,
+    )
 
     n = 1024 if QUICK else 4096
     sets = make_synthetic_sets(n, seed=31)
@@ -353,9 +418,11 @@ def config2_gossip_replay_pipelined():
         return n / dt, (100.0 * overlap / verify) if verify else 0.0
 
     prev = configure_device_prep(mode="on")
+    prev_single = configure_single_launch(mode="off")
     try:
         rate, overlap_pct = asyncio.run(run())
     finally:
+        configure_single_launch(mode=prev_single)
         configure_device_prep(mode=prev)
     _line("pipelined_gossip_replay_sigs_per_sec", rate, "sigs/s",
           rate / REFERENCE_SIGS_PER_SEC_PER_CORE)
@@ -657,8 +724,10 @@ def main():
     state_htr_rate()
     epoch_htr_replay()
     config5_backfill_window()
+    single_launch_schedule()
     config2_gossip_replay()
     config2_gossip_replay(device_prep=True)
+    config2_gossip_replay(single_launch=True)
     config2_gossip_replay_pipelined()
     config3_sync_committee_aggregate()
     mesh_scaling()

@@ -56,6 +56,12 @@ THRESHOLDS: dict[str, float] = {
     "device_prep_sets_per_sec": 0.5,
     "prep_launches_per_set": 0.05,
     "prep_launches_per_set_unfused": 0.05,
+    # single-launch dispatch budget: 1 program per verified batch vs the
+    # 3+verify split reference — a schedule invariant, gated tight (a
+    # fused chain quietly growing a second launch IS the regression)
+    "e2e_launches_per_batch": 0.05,
+    "e2e_launches_per_batch_split": 0.05,
+    "single_launch_replay_sigs_per_sec": 0.5,
     "merkle_sha256_pair_hashes_per_sec": 0.5,
     "state_htr_chunks_per_sec": 0.5,
     "epoch_htr_ms_device": 0.75,
@@ -87,6 +93,8 @@ LOWER_IS_BETTER: set = {
     "two_tenant_fairness_share_error_pct",
     "prep_launches_per_set",
     "prep_launches_per_set_unfused",
+    "e2e_launches_per_batch",
+    "e2e_launches_per_batch_split",
 }
 
 #: fallback for a metric a newer bench emits before its threshold

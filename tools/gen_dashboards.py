@@ -138,6 +138,10 @@ def bls_pool():
             "Input prep fallbacks / rejected batches",
             [
                 ("rate(lodestar_bls_prep_fallback_total[1m])", "device→host fallbacks"),
+                (
+                    "rate(lodestar_bls_single_launch_fallback_total[1m])",
+                    "single-launch→split fallbacks",
+                ),
                 ("rate(lodestar_bls_prep_rejected_total[1m])", "rejected batches"),
             ],
             unit="ops", x=0, y=24, pid=7,
@@ -151,13 +155,39 @@ def bls_pool():
             # budget invariant lives in the tests. BOTH operands wrapped
             # in sum(): a labeled-vs-aggregated vector match is empty
             # and renders the panel permanently blank (the PR 7 round-5
-            # launches/flush lesson).
+            # launches/flush lesson). The plain
+            # lodestar_bls_prep_launches_total counter counts EVERY
+            # dispatch at the seam (single-launch verifies included
+            # since round 13), so the split-schedule numerator
+            # subtracts the single-launch program's telemetry count —
+            # with the `or vector(0)` guard so the subtraction (and the
+            # panel) still renders when telemetry is off or no
+            # single-launch traffic exists. Known over-reads, both
+            # deliberate: with telemetry off + single-launch on the
+            # series blends the schedules (no per-program signal to
+            # subtract), and during a single-launch fallback storm the
+            # FAILED dispatches stay in the numerator (the counter
+            # ticks at dispatch, the histogram only on success) — an
+            # elevated split series next to a busy fallbacks panel is
+            # the storm being visible, not a split-schedule regression.
+            # The single-launch
+            # series reads the one-program schedule
+            # (--bls-single-launch): numerator = the single-launch
+            # program's dispatches, denominator the sets staged under
+            # the single_launch prep layer — at budget it tracks
+            # 1/batch-size while the split series tracks 3/batch-size.
             "Prep launches per set (device layer)",
             [
                 (
-                    "sum(rate(lodestar_bls_prep_launches_total[5m])) / "
+                    "(sum(rate(lodestar_bls_prep_launches_total[5m])) - "
+                    "(sum(rate(lodestar_device_launch_seconds_count{program=\"_single_launch_verify\"}[5m])) or vector(0))) / "
                     "sum(rate(lodestar_bls_prep_sets_total{layer=\"device\"}[5m]))",
-                    "launches/set",
+                    "split-schedule launches/set",
+                ),
+                (
+                    "sum(rate(lodestar_device_launch_seconds_count{program=\"_single_launch_verify\"}[5m])) / "
+                    "sum(rate(lodestar_bls_prep_sets_total{layer=\"single_launch\"}[5m]))",
+                    "single-launch launches/set",
                 ),
             ],
             unit="ops", x=12, y=24, pid=8,
