@@ -1106,20 +1106,44 @@ class BeaconApiImpl:
         traces = tracing.get_tracer().recent_traces(count)
         return {"data": [t.to_dict() for t in traces]}
 
-    def get_debug_launches(self, count: int = 64) -> dict:
+    def get_debug_launches(self, count: int = 64, program: str | None = None) -> dict:
         """The device launch ledger (`lodestar_tpu/telemetry.py`): the
         trailing `count` dispatches at the counted launch seams, plus
         the cumulative totals — a slow slot's launches by name without
-        waiting for a Prometheus scrape."""
+        waiting for a Prometheus scrape. `program` narrows the ledger
+        view to one dispatch seam (chip-run triage of a single program);
+        an unknown name is a 400, not an empty list — a typo'd filter
+        must not read as 'that program never launched'."""
         from lodestar_tpu import telemetry
 
+        entries = telemetry.launch_ledger(max(0, count))
+        if program is not None:
+            known = telemetry.known_programs()
+            if program not in known:
+                raise ApiError(
+                    400,
+                    f"unknown program {program!r}; launched so far: "
+                    f"{sorted(known) or '(none)'}",
+                )
+            entries = [e for e in entries if e["program"] == program]
         return {
             "data": {
                 "mode_active": telemetry.launch_telemetry_active(),
                 "totals": telemetry.launch_totals(),
-                "launches": telemetry.launch_ledger(max(0, count)),
+                "launches": entries,
             }
         }
+
+    def get_debug_slo(self) -> dict:
+        """The slot-deadline SLO view (`lodestar_tpu/slo`): per-class
+        wait-budget decomposition (buffer/queue/stage/launch quantiles
+        whose legs partition the end-to-end span), SLI counters, and
+        the live per-class slack snapshot — the machine-readable
+        wait-budget profile the batch former consumes
+        (`tools/wait_budget_profile.py`)."""
+        from lodestar_tpu import slo
+
+        return {"data": slo.debug_view()}
 
     def get_fork_choice_nodes(self) -> dict:
         fc = self.chain.fork_choice.proto_array

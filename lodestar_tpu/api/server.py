@@ -79,6 +79,7 @@ ROUTES: list[tuple[str, str, str]] = [
     ("GET", r"/eth/v0/debug/traces", "r_debug_traces_recent"),
     ("GET", r"/eth/v0/debug/traces/(?P<slot>\d+)", "r_debug_traces"),
     ("GET", r"/eth/v0/debug/launches", "r_debug_launches"),
+    ("GET", r"/eth/v0/debug/slo", "r_debug_slo"),
     ("GET", r"/eth/v1/config/spec", "r_spec"),
     ("GET", r"/eth/v1/config/fork_schedule", "r_fork_schedule"),
     ("GET", r"/eth/v1/config/deposit_contract", "r_deposit_contract"),
@@ -300,7 +301,10 @@ class _Router:
             count = int(raw)
         except ValueError:
             raise ApiError(400, f"count must be an integer, got {raw!r}") from None
-        return self.api.get_debug_launches(count)
+        return self.api.get_debug_launches(count, program=(query or {}).get("program"))
+
+    def r_debug_slo(self, **kw):
+        return self.api.get_debug_slo()
 
     def r_fork_schedule(self, **kw):
         return self.api.get_fork_schedule()

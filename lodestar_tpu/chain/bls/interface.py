@@ -34,11 +34,17 @@ class VerifySignatureOpts:
     from the call site — gossip block > gossip attestation > API >
     range sync > backfill. None means API (the neutral middle class);
     verifiers without a scheduler ignore it.
+    slot: the subject slot of the work (a block's slot), anchoring the
+    SLO layer's deadline math (`lodestar_tpu/slo`). None anchors at the
+    wall-clock slot when the job is enqueued — right for work with no
+    subject slot (attestation aggregates, API batches); verifiers
+    without slack accounting ignore it.
     """
 
     batchable: bool = False
     verify_on_main_thread: bool = False
     priority: "int | None" = None
+    slot: "int | None" = None
 
 
 class IBlsVerifier(abc.ABC):

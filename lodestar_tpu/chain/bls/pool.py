@@ -62,7 +62,7 @@ import threading
 import time
 from typing import Awaitable, Callable, Sequence
 
-from lodestar_tpu import tracing
+from lodestar_tpu import slo, tracing
 from lodestar_tpu.crypto.bls.api import SignatureSet
 from lodestar_tpu.logger import get_logger
 from lodestar_tpu.scheduler import (
@@ -141,13 +141,14 @@ def chunkify_maximize_chunk_size(arr: Sequence, max_len: int) -> list[list]:
 
 
 class _Job:
-    __slots__ = ("sets", "batchable", "priority", "future", "added_ns", "trace_parent")
+    __slots__ = ("sets", "batchable", "priority", "future", "added_ns", "trace_parent", "slo")
 
     def __init__(
         self,
         sets: list[SignatureSet],
         batchable: bool,
         priority: PriorityClass = PriorityClass.API,
+        slot: int | None = None,
     ):
         self.sets = sets
         self.batchable = batchable
@@ -159,6 +160,9 @@ class _Job:
         # clock read rides the same gate — untraced jobs pay nothing
         self.trace_parent = tracing.current()
         self.added_ns = time.monotonic_ns() if self.trace_parent is not None else 0
+        # slot-deadline slack ledger (None when the SLO layer is off —
+        # the unconfigured path pays one None check per lifecycle edge)
+        self.slo = slo.job_begin(priority, slot)
 
 
 class _OverlapTracker:
@@ -451,7 +455,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         )
         self._ensure_runner()
         jobs = [
-            self._enqueue(_Job(chunk, opts.batchable, priority))
+            self._enqueue(_Job(chunk, opts.batchable, priority, opts.slot))
             for chunk in chunkify_maximize_chunk_size(sets, MAX_SIGNATURE_SETS_PER_JOB)
         ]
         results = await asyncio.gather(*(j.future for j in jobs))
@@ -533,7 +537,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
 
     def _enqueue(self, job: _Job) -> _Job:
         self._outstanding += 1
-        job.future.add_done_callback(lambda _f: self._dec_outstanding())
+        job.future.add_done_callback(lambda f, j=job: self._on_job_done(j, f))
         if job.batchable:
             self._buffered.append(job)
             self._buffered_sigs += len(job.sets)
@@ -551,6 +555,16 @@ class BlsDeviceVerifierPool(IBlsVerifier):
     def _dec_outstanding(self) -> None:
         self._outstanding -= 1
 
+    def _on_job_done(self, job: _Job, f: "asyncio.Future[bool]") -> None:
+        """The job future resolves exactly once — however many batch
+        retries the verdict took — so this callback is the one place a
+        per-job SLO verdict can't double-count. Cancellation (shutdown)
+        is not a deadline miss and records nothing."""
+        self._dec_outstanding()
+        if job.slo is not None and not f.cancelled():
+            ok = f.exception() is None and f.result() is True
+            slo.job_verdict(job.slo, ok)
+
     def _flush_buffer(self) -> None:
         if self._buffer_timer is not None:
             self._buffer_timer.cancel()
@@ -558,6 +572,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         jobs, self._buffered = self._buffered, []
         self._buffered_sigs = 0
         for job in jobs:
+            slo.job_flushed(job.slo)
             self._jobs.put_nowait(job, job.priority)
 
     # -- execution ------------------------------------------------------------
@@ -565,6 +580,7 @@ class BlsDeviceVerifierPool(IBlsVerifier):
     def _record_sched_dequeue(self, job: _Job, cls: PriorityClass, waited_ns: int) -> None:
         """`sched_queue_wait` span per traced job: enqueue -> dequeue —
         the number the saturation acceptance test bounds."""
+        slo.job_dequeued(job.slo, waited_ns)
         if job.trace_parent is not None:
             end_ns = time.monotonic_ns()
             tracing.record(
@@ -962,6 +978,10 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         if not counted:
             self.metrics["jobs_started"] += len(package)
             self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
+            # SLO launch stamp once per job: the sharded fallback road
+            # (counted=True) already stamped at its collective launch
+            for j in package:
+                slo.job_launch(j.slo)
 
         # tracing work (incl. the clock reads) only when some job in the
         # package was submitted under an active trace — the disabled path
@@ -1087,6 +1107,8 @@ class BlsDeviceVerifierPool(IBlsVerifier):
         single-device policy)."""
         self.metrics["jobs_started"] += len(package)
         self.metrics["sig_sets_started"] += sum(len(j.sets) for j in package)
+        for j in package:
+            slo.job_launch(j.slo)
         all_sets = [s for j in package for s in j.sets]
         traced = any(j.trace_parent is not None for j in package)
         if traced:

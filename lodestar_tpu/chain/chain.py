@@ -21,7 +21,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
-from lodestar_tpu import tracing
+from lodestar_tpu import slo, tracing
 from lodestar_tpu.db import Bucket, DbController, Repository
 from lodestar_tpu.fork_choice import Checkpoint, ForkChoice, ProtoBlock
 from lodestar_tpu.logger import get_logger
@@ -438,9 +438,18 @@ class BeaconChain:
                 if sp:
                     sp.set(sets=len(sets))
                 ok = await self.bls.verify_signature_sets(
-                    sets, VerifySignatureOpts(batchable=False, priority=priority)
+                    sets,
+                    VerifySignatureOpts(
+                        batchable=False, priority=priority, slot=int(block.slot)
+                    ),
                 )
                 if sp:
+                    # remaining slot-deadline slack when the verdict
+                    # landed (None = SLO layer off) — the slow-slot dump
+                    # answers "did we still make the deadline" inline
+                    slack = slo.slack_ms(priority, int(block.slot))
+                    if slack is not None:
+                        sp.set(slack_ms=slack)
                     # DegradingBlsVerifier names the layer that actually
                     # served — a slow-slot dump shows degraded imports.
                     # serving_layer() is a contextvar read: this TASK's

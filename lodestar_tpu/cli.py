@@ -54,6 +54,24 @@ def _add_tracing_args(sp) -> None:
     )
 
 
+def _add_slo_args(sp) -> None:
+    """Slot-deadline SLO flags (lodestar_tpu.slo), shared by the
+    node-running commands."""
+    sp.add_argument(
+        "--slo-disable", action="store_true",
+        help="disable slot-deadline SLO accounting (per-class remaining-"
+        "slack histograms, deadline-miss counters, good/total SLI pairs, "
+        "the GET /eth/v0/debug/slo wait-budget profile, and the slack "
+        "attributes on bls_verify/block_import spans and slow-slot dumps)",
+    )
+    sp.add_argument(
+        "--slo-slack-floor-ms", type=float, default=0.0,
+        help="treat a verdict landing with less than this much remaining "
+        "slot-deadline slack as a deadline miss (0 = miss only when the "
+        "deadline is actually blown; raise to alert before the cliff)",
+    )
+
+
 def _add_scheduler_args(sp) -> None:
     """Device work scheduler + offload flags (lodestar_tpu.scheduler),
     shared by the node-running commands."""
@@ -202,6 +220,7 @@ def _build_parser(with_subparsers: bool = False):
     dev.add_argument("--altair-epoch", type=int, default=None, help="enable the altair fork at this epoch (default: never)")
     _add_tracing_args(dev)
     _add_scheduler_args(dev)
+    _add_slo_args(dev)
 
     beacon = sub.add_parser("beacon", help="run a beacon node")
     beacon.add_argument("--db", default=None, help="data directory (default: in-memory)")
@@ -223,6 +242,7 @@ def _build_parser(with_subparsers: bool = False):
     )
     _add_tracing_args(beacon)
     _add_scheduler_args(beacon)
+    _add_slo_args(beacon)
 
     val = sub.add_parser("validator", help="run a REST-mode validator client")
     val.add_argument("--beacon-url", default="http://127.0.0.1:9596")
@@ -392,6 +412,8 @@ async def _run_dev(args) -> int:
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,
             launch_telemetry=args.launch_telemetry,
+            slo_enabled=not args.slo_disable,
+            slo_slack_floor_ms=args.slo_slack_floor_ms,
         ),
         p=p,
         time_fn=lambda: now[0],
@@ -563,6 +585,8 @@ async def _run_beacon(args) -> int:
             bls_mesh=args.bls_mesh,
             offload_tenant=args.offload_tenant,
             launch_telemetry=args.launch_telemetry,
+            slo_enabled=not args.slo_disable,
+            slo_slack_floor_ms=args.slo_slack_floor_ms,
         ),
         p=p,
         db=db,

@@ -32,6 +32,7 @@ __all__ = [
     "BlsPipelineMetrics",
     "DeviceLaunchMetrics",
     "TraceMetrics",
+    "SloMetrics",
     "SchedulerMetrics",
     "ResilienceMetrics",
     "AuditMetrics",
@@ -334,6 +335,7 @@ class TenantMetrics:
     shed: Counter  # admission sheds, labeled by tenant + reason (quota/slot_timeout)
     inflight: Gauge  # granted service slots, labeled by tenant
     quota_weight: Gauge  # configured stride weight, labeled by tenant
+    slack: Histogram  # remaining slot-deadline slack at verdict, by tenant + class
 
 
 def create_tenant_metrics(creator: "RegistryMetricCreator | None" = None) -> TenantMetrics:
@@ -361,6 +363,15 @@ def create_tenant_metrics(creator: "RegistryMetricCreator | None" = None) -> Ten
             "lodestar_offload_tenant_quota_weight",
             "Configured stride-fair service weight per tenant",
             ["tenant"],
+        ),
+        slack=c.histogram(
+            "lodestar_offload_tenant_slack_seconds",
+            "Remaining slot-deadline slack at verdict per tenant and "
+            "priority class (negative = the verdict landed past the "
+            "class deadline) — requires the server to be launched with "
+            "--genesis-time so it shares the tenants' slot clock",
+            _SEC_SLACK,
+            ["tenant", "class"],
         ),
     )
 
@@ -441,6 +452,20 @@ class TraceMetrics:
 
 
 @dataclass
+class SloMetrics:
+    """lodestar_slo_* — slot-deadline SLO accounting (`lodestar_tpu/slo`):
+    remaining-slack histograms per priority class at each lifecycle
+    stage (enqueue/dispatch/verdict), deadline-miss counters, and the
+    good/total SLI pair the generated multi-window burn-rate alerts
+    (`tools/gen_alerts.py`) consume as numerator/denominator."""
+
+    slack_seconds: Histogram  # remaining slack (negative = past deadline), by class + stage
+    deadline_miss: Counter  # verdicts that landed under the slack floor, by class
+    sli_good: Counter  # SLI numerator: ok verdicts inside the deadline, by class
+    sli_total: Counter  # SLI denominator: all verdicts, by class
+
+
+@dataclass
 class BeaconMetrics:
     creator: RegistryMetricCreator
     bls_pool: BlsPoolMetrics
@@ -465,6 +490,7 @@ class BeaconMetrics:
     chain: "ChainDetailMetrics"
     process: "ProcessMetrics"
     trace: "TraceMetrics"
+    slo: "SloMetrics"
     sched: "SchedulerMetrics"
     resilience: "ResilienceMetrics"
     audit: "AuditMetrics"
@@ -481,6 +507,20 @@ class BeaconMetrics:
 
 _SEC_SMALL = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5)
 _SEC_TINY = (0.0001, 0.001, 0.01, 0.1, 1)
+#: launch-latency ladder: dense below 5 ms (steady-state dispatches all
+#: land there — the old ladder jumped 1→5→50 ms and folded every
+#: healthy launch into two buckets), then stretching to slot length and
+#: the worst trace+compile stall
+_SEC_LAUNCH = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1, 2, 5, 12, 30, 120,
+)
+#: slack ladder: symmetric around the deadline — negative buckets size
+#: the miss (how late), positive buckets the margin, bounded at ±slot
+#: lengths (a backfill job can hold multi-slot slack)
+_SEC_SLACK = (
+    -12, -4, -1, -0.25, -0.05, 0, 0.05, 0.1, 0.25, 0.5, 1, 2, 4, 8, 12, 48, 384,
+)
 
 
 def create_metrics() -> BeaconMetrics:
@@ -581,7 +621,7 @@ def create_metrics() -> BeaconMetrics:
             "program and pow-2 size class (host-observed: includes device "
             "execution on synchronous backends and trace+compile on the "
             "first call per class)",
-            (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120),
+            _SEC_LAUNCH,
             ["program", "size_class"],
         ),
         compile_seconds=c.counter(
@@ -1009,6 +1049,33 @@ def create_metrics() -> BeaconMetrics:
             "CPU time spent re-verifying sampled verdicts (budget accounting)",
         ),
     )
+    slo = SloMetrics(
+        slack_seconds=c.histogram(
+            "lodestar_slo_slack_seconds",
+            "Remaining slot-deadline slack per priority class at each "
+            "lifecycle stage (enqueue/dispatch/verdict); negative = the "
+            "stage happened past the class deadline",
+            _SEC_SLACK,
+            ["class", "stage"],
+        ),
+        deadline_miss=c.counter(
+            "lodestar_slo_deadline_miss_total",
+            "Verdicts that landed with less slack than the configured "
+            "floor (--slo-slack-floor-ms), counted once per job",
+            ["class"],
+        ),
+        sli_good=c.counter(
+            "lodestar_slo_sli_good_total",
+            "SLI numerator: verdicts that were ok AND inside the class "
+            "deadline (pairs with lodestar_slo_sli_total for burn rates)",
+            ["class"],
+        ),
+        sli_total=c.counter(
+            "lodestar_slo_sli_total",
+            "SLI denominator: all verdicts, counted once per job",
+            ["class"],
+        ),
+    )
     sched = SchedulerMetrics(
         queue_depth=c.gauge(
             "lodestar_sched_queue_depth", "Device scheduler queue depth", ["class"]
@@ -1079,6 +1146,7 @@ def create_metrics() -> BeaconMetrics:
         chain=chain,
         process=process,
         trace=trace,
+        slo=slo,
         sched=sched,
         resilience=resilience,
         audit=audit,

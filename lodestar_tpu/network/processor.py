@@ -15,10 +15,23 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
-from lodestar_tpu import tracing
+from lodestar_tpu import slo, tracing
 from lodestar_tpu.logger import get_logger
 
 __all__ = ["NetworkProcessor", "GOSSIP_QUEUE_OPTS", "default_gossip_handlers"]
+
+
+def _stamp_import_slack(rt, slot: int) -> None:
+    """Remaining slot-deadline slack when a gossip block import
+    finished, stamped on the `block_import` root span (so a slow-slot
+    dump answers "did we still make the attestation cutoff" without a
+    metrics query). No-op when tracing or the SLO layer is off."""
+    if rt:
+        from lodestar_tpu.scheduler import PriorityClass
+
+        slack = slo.slack_ms(PriorityClass.GOSSIP_BLOCK, slot)
+        if slack is not None:
+            rt.set(slack_ms=slack)
 
 MAX_JOBS_SUBMITTED_PER_TICK = 128
 
@@ -282,7 +295,7 @@ def default_gossip_handlers(chain) -> dict:
     async def on_block(message, peer):
         # root span: the whole slot pipeline (gossip validation → BLS →
         # STF → fork choice) stitches under this one trace
-        with tracing.root("block_import", slot=int(message.message.slot)):
+        with tracing.root("block_import", slot=int(message.message.slot)) as rt:
             try:
                 validate_gossip_block(chain, message)
             except GossipValidationError as e:
@@ -291,11 +304,12 @@ def default_gossip_handlers(chain) -> dict:
                     raise
                 return  # duplicates / future / parent-unknown are benign
             await chain.process_block(message, is_timely=True)
+            _stamp_import_slack(rt, int(message.message.slot))
 
     async def on_block_and_blobs(message, peer):
         from lodestar_tpu.chain.validation import validate_gossip_block_and_blobs_sidecar
 
-        with tracing.root("block_import", slot=int(message.beacon_block.message.slot)):
+        with tracing.root("block_import", slot=int(message.beacon_block.message.slot)) as rt:
             try:
                 validate_gossip_block_and_blobs_sidecar(chain, message)
             except GossipValidationError as e:
@@ -305,6 +319,7 @@ def default_gossip_handlers(chain) -> dict:
                 return
             await chain.process_block(message.beacon_block, is_timely=True)
             chain.put_blobs_sidecar(message.blobs_sidecar)
+            _stamp_import_slack(rt, int(message.beacon_block.message.slot))
 
     async def on_attestation(message, peer):
         try:

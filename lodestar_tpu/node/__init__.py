@@ -60,6 +60,8 @@ class BeaconNodeOptions:
         bls_mesh: str = "auto",
         offload_tenant: str | None = None,
         launch_telemetry: str = "auto",
+        slo_enabled: bool = True,
+        slo_slack_floor_ms: float = 0.0,
     ):
         self.db_path = db_path
         self.rest_port = rest_port
@@ -220,6 +222,17 @@ class BeaconNodeOptions:
                 f"launch_telemetry must be one of {TELEMETRY_MODES}, got {launch_telemetry!r}"
             )
         self.launch_telemetry = launch_telemetry
+        # slot-deadline SLO accounting (lodestar_tpu/slo): per-priority-
+        # class deadline slack at enqueue/dispatch/verdict plus the
+        # good/total SLI pairs. The slack floor widens the miss margin
+        # (0 = miss only when the deadline is actually blown); negative
+        # would silently forgive real misses, so it is a startup error
+        if slo_slack_floor_ms < 0:
+            raise ValueError(
+                f"slo_slack_floor_ms must be >= 0, got {slo_slack_floor_ms!r}"
+            )
+        self.slo_enabled = slo_enabled
+        self.slo_slack_floor_ms = slo_slack_floor_ms
 
 
 class BeaconNode:
@@ -479,6 +492,25 @@ class BeaconNode:
         if time_fn is not None:
             clock_kwargs["time_fn"] = time_fn
         clock = Clock(**clock_kwargs)
+
+        # 4b. slot-deadline SLO accounting: process-global like the
+        # tracer (the verify pool and gossip processor live below any
+        # node object). Configured here because this is the first point
+        # where genesis_time is known; shares the clock's time_fn so a
+        # manual/dev clock keeps the deadline math deterministic
+        from lodestar_tpu import slo as _slo
+
+        slo_kwargs = dict(
+            enabled=opts.slo_enabled,
+            genesis_time=anchor_state.genesis_time,
+            seconds_per_slot=clock_kwargs["seconds_per_slot"],
+            slots_per_epoch=p.SLOTS_PER_EPOCH,
+            metrics=metrics.slo,
+            slack_floor_ms=opts.slo_slack_floor_ms,
+        )
+        if time_fn is not None:
+            slo_kwargs["time_fn"] = time_fn
+        _slo.configure_slo(**slo_kwargs)
 
         # 5. chain
         chain = BeaconChain(

@@ -138,6 +138,7 @@ class BlsOffloadServer:
         tenant_metrics=None,
         chip_status_fn=None,
         slot_wait_margin_s: float = 0.5,
+        deadline_model=None,
     ) -> None:
         self.backend = backend
         self._can_accept_work = can_accept_work or (lambda: True)
@@ -179,6 +180,11 @@ class BlsOffloadServer:
             tenancy_kwargs["reject_depth"] = tenant_reject_depth
         self.tenancy = tenancy or TenantScheduler(**tenancy_kwargs)
         self._tenant_metrics = tenant_metrics
+        # slot-deadline model (lodestar_tpu/slo.SlotDeadlineModel, or
+        # None when the host wasn't launched with --genesis-time): lets
+        # a multi-tenant host observe per-tenant remaining deadline
+        # slack at verdict time — "which tenant are we serving too late"
+        self._deadline_model = deadline_model
         self._chip_status_fn = chip_status_fn
         # reply-wire + expected-backend-launch reserve subtracted from
         # the caller's RPC deadline when waiting for a service slot
@@ -292,6 +298,20 @@ class BlsOffloadServer:
             m = self._tenant_metrics
             if m is not None:
                 m.served_sets.labels(tenant).inc(len(sets))
+                dm = self._deadline_model
+                if dm is not None:
+                    try:
+                        # anchored at the wall-clock slot: the wire
+                        # trailer carries tenant+class, not the subject
+                        # slot, so the host measures "slack left in the
+                        # slot being served right now" — negative means
+                        # this tenant's verdicts are landing past the
+                        # class cutoff
+                        m.slack.labels(tenant, priority.label).observe(
+                            dm.slack_s(priority)
+                        )
+                    except Exception:
+                        pass  # slack observation must never fail a verdict
             # digest-checked verdict: binds this reply to this request
             # frame so corruption/splicing fails closed at the client
             out = encode_verdict(ok, request=request)
@@ -393,6 +413,16 @@ def main() -> int:
         "--tenant-reject-depth", type=int, default=DEFAULT_TENANT_REJECT_DEPTH,
         help="per-tenant pending+running depth at which everything sheds",
     )
+    ap.add_argument(
+        "--genesis-time", type=int, default=None,
+        help="chain genesis timestamp (unix seconds): enables the "
+        "lodestar_offload_tenant_slack_seconds histogram — per-tenant "
+        "remaining slot-deadline slack at verdict time",
+    )
+    ap.add_argument(
+        "--seconds-per-slot", type=int, default=12,
+        help="slot length for the deadline model (with --genesis-time)",
+    )
     args = ap.parse_args()
 
     from lodestar_tpu.crypto.bls.api import verify_signature_sets
@@ -453,6 +483,15 @@ def main() -> int:
         metrics_server = MetricsServer(creator, port=args.metrics_port)
         metrics_server.start()
 
+    deadline_model = None
+    if args.genesis_time is not None:
+        from lodestar_tpu.slo import SlotDeadlineModel
+
+        deadline_model = SlotDeadlineModel(
+            genesis_time=args.genesis_time,
+            seconds_per_slot=args.seconds_per_slot,
+        )
+
     server = BlsOffloadServer(
         backend,
         port=args.port,
@@ -467,6 +506,7 @@ def main() -> int:
         tenant_reject_depth=args.tenant_reject_depth,
         tenant_metrics=tenant_metrics,
         chip_status_fn=chip_status_fn,
+        deadline_model=deadline_model,
     )
     # surface the effective tenancy config once, for operators' logs
     server.log.info(

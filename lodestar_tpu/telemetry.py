@@ -78,6 +78,7 @@ __all__ = [
     "size_class_of",
     "launch_ledger",
     "launch_totals",
+    "known_programs",
     "slow_slot_launches",
     "reset_launch_telemetry",
 ]
@@ -246,10 +247,20 @@ def launch_totals() -> dict:
     }
 
 
+def known_programs() -> set[str]:
+    """Program names that have dispatched at least once in this process
+    (the compile-detection key universe) — the validation set for the
+    debug route's `?program=` filter."""
+    with _lock:
+        return {k[0] for k in _seen_keys}
+
+
 def slow_slot_launches(n: int = 12) -> dict:
     """Compact launch view for slow-slot dumps: the trailing `n`
     dispatches as one-line strings plus the cumulative counts — a slow
-    slot names its launches without a second query."""
+    slot names its launches without a second query. When the SLO layer
+    is configured, the dump also names the per-class remaining deadline
+    slack at dump time ("did we still make the cutoff" inline)."""
     entries = launch_ledger(n)
     recent = [
         "{program}/{size_class} {ms:.1f}ms{lane}{comp}".format(
@@ -262,7 +273,15 @@ def slow_slot_launches(n: int = 12) -> dict:
         for e in entries
     ]
     with _lock:
-        return {"launches_total": _seq, "compiles_total": _compiles, "recent": recent}
+        out = {"launches_total": _seq, "compiles_total": _compiles, "recent": recent}
+    # lazy one-way import (slo never imports telemetry); stdlib-only on
+    # both sides, so the import-hygiene doctrine holds
+    from lodestar_tpu import slo
+
+    slack = slo.slow_slot_slack()
+    if slack:
+        out["deadline_slack"] = slack
+    return out
 
 
 def reset_launch_telemetry() -> None:

@@ -1,0 +1,10 @@
+"""Fixture: an SLO family no alert reads, plus rules with ghost
+samples / missing severity / missing summary / duplicate names."""
+
+
+class Metrics:
+    def __init__(self, creator):
+        # referenced by the rules below (as _bucket/_count samples)
+        self.covered = creator.histogram("lodestar_slo_covered_seconds", "covered")
+        # read by NO alert expr and not allowlisted -> finding
+        self.orphan = creator.counter("lodestar_slo_orphan_total", "orphan")

@@ -1,0 +1,15 @@
+"""Fixture: every lodestar_slo_* family alerted, every expr token
+derivable — clean."""
+
+
+class Metrics:
+    def __init__(self, creator):
+        self.sli_good = creator.counter("lodestar_slo_sli_good_total", "good")
+        self.sli_total = creator.counter("lodestar_slo_sli_total", "total")
+        self.slack = creator.histogram("lodestar_slo_slack_seconds", "slack")
+        # declared WITHOUT _total; prometheus_client still exposes
+        # <name>_total, and the alert references the suffixed sample
+        self.miss = creator.counter("lodestar_slo_miss", "misses")
+        # non-SLO family: the registry->alerts direction must NOT
+        # demand a rule for it (gauge, referenced anyway here)
+        self.state = creator.gauge("lodestar_fixture_state", "state")

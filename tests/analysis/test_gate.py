@@ -29,6 +29,20 @@ def test_tree_is_clean_under_every_rule():
     assert dt < 30.0, f"analysis took {dt:.1f}s — the gate must stay cheap"
 
 
+def test_gen_alerts_regen_is_noop():
+    """The committed alert rules are exactly what tools/gen_alerts.py
+    generates (byte-stable JSON-as-YAML) — drift in either the
+    generator or a hand-edit of alerts/ fails the gate."""
+    res = subprocess.run(
+        [sys.executable, "tools/gen_alerts.py", "--check"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
 def test_at_least_six_rules_registered():
     assert len(ALL_RULES) >= 6
     assert len({r.name for r in ALL_RULES}) == len(ALL_RULES)

@@ -373,3 +373,49 @@ def test_bench_wiring_real_tree_is_clean():
         pragma_hygiene=False,
     )
     assert findings == [], [f.format() for f in findings]
+
+
+# -- alert-wiring (project-scoped) --------------------------------------------
+
+
+def alert_wiring_findings(root: str):
+    return analyze(
+        [],
+        rules=[RULES_BY_NAME["alert-wiring"]],
+        repo_root=FIXTURES / root,
+        pragma_hygiene=False,
+    )
+
+
+def test_alert_wiring_flags_every_gap_class():
+    msgs = [f.message for f in alert_wiring_findings("alert_wiring_bad")]
+    joined = " | ".join(msgs)
+    # alerts -> registry: expr over a sample no family exposes
+    assert "'lodestar_ghost_metric_total' which no registered metric family" in joined
+    # hygiene: severity routes, summary explains, names dedup
+    assert "alert 'NoSeverity' has no severity label" in joined
+    assert "alert 'NoSummary' has no summary annotation" in joined
+    assert "alert name 'GhostSample' is duplicated" in joined
+    # registry -> alerts: an SLO family no rule reads
+    assert "SLO metric family 'lodestar_slo_orphan_total'" in joined
+    # a non-JSON rule file is a finding, not a crash
+    assert "not the JSON-content YAML" in joined
+    assert len(msgs) == 6, joined
+
+
+def test_alert_wiring_clean_tree():
+    """Clean fixture also proves sample derivation: the rules reference
+    lodestar_slo_miss_total for a counter declared as 'lodestar_slo_miss',
+    and _bucket/_count samples for the slack histogram."""
+    assert alert_wiring_findings("alert_wiring_ok") == []
+
+
+def test_alert_wiring_real_tree_is_clean():
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    findings = analyze(
+        [],
+        rules=[RULES_BY_NAME["alert-wiring"]],
+        repo_root=repo,
+        pragma_hygiene=False,
+    )
+    assert findings == [], [f.format() for f in findings]

@@ -217,8 +217,8 @@ def test_debug_and_config(env):
 
 def test_debug_launches_route_contract(env):
     """GET /eth/v0/debug/launches: the launch-telemetry ledger behind
-    the debug namespace — totals + entries, count slicing, 400 on a
-    non-integer count."""
+    the debug namespace — totals + entries, count slicing, ?program=
+    narrowing (400 on an unknown name), 400 on a non-integer count."""
     from lodestar_tpu import telemetry
 
     p, chain, blocks, client = env
@@ -227,22 +227,71 @@ def test_debug_launches_route_contract(env):
     try:
         for i in range(5):
             telemetry.record_launch("contract_prog", 8, 0.001 * (i + 1), lane="dev0")
+        telemetry.record_launch("other_prog", 4, 0.002, lane="dev1")
         out = client._req("GET", "/eth/v0/debug/launches")["data"]
         assert out["mode_active"] is True
-        assert out["totals"]["launches"] == 5
-        assert out["totals"]["ledger_by_program"] == {"contract_prog": 5}
-        assert len(out["launches"]) == 5
-        entry = out["launches"][-1]
+        assert out["totals"]["launches"] == 6
+        assert out["totals"]["ledger_by_program"] == {
+            "contract_prog": 5,
+            "other_prog": 1,
+        }
+        assert len(out["launches"]) == 6
+        entry = out["launches"][-2]
         assert entry["program"] == "contract_prog"
         assert entry["size_class"] == 8
         assert entry["lane"] == "dev0"
         assert entry["compile"] is False  # only the first (prog, 8) compiled
         # count slicing keeps the NEWEST entries
         out2 = client._req("GET", "/eth/v0/debug/launches", {"count": "2"})["data"]
-        assert [e["seq"] for e in out2["launches"]] == [4, 5]
+        assert [e["seq"] for e in out2["launches"]] == [5, 6]
+        # ?program= narrows the ledger view to one dispatch seam
+        out3 = client._req(
+            "GET", "/eth/v0/debug/launches", {"program": "contract_prog"}
+        )["data"]
+        assert len(out3["launches"]) == 5
+        assert all(e["program"] == "contract_prog" for e in out3["launches"])
+        # totals stay global so a filtered view still shows the whole ledger
+        assert out3["totals"]["launches"] == 6
+        # a typo'd program is a 400 naming the known set, not an empty list
+        with pytest.raises(ApiClientError) as e:
+            client._req("GET", "/eth/v0/debug/launches", {"program": "no_such_prog"})
+        assert e.value.status == 400
         # contract: non-integer count is a 400, not a 500
         with pytest.raises(ApiClientError) as e:
             client._req("GET", "/eth/v0/debug/launches", {"count": "soon"})
         assert e.value.status == 400
     finally:
         telemetry.reset_launch_telemetry()
+
+
+def test_debug_slo_route_contract(env):
+    """GET /eth/v0/debug/slo: the wait-budget profile — deadline model,
+    per-class legs/sli, and the live slack snapshot; shape must stay
+    stable for tools/wait_budget_profile.py."""
+    import time
+
+    from lodestar_tpu import slo
+
+    p, chain, blocks, client = env
+    slo.reset_slo()
+    try:
+        # inactive: enabled=False with empty classes, no deadline model
+        out = client._req("GET", "/eth/v0/debug/slo")["data"]
+        assert out["enabled"] is False
+        assert out["classes"] == {}
+
+        # 2s into slot 0: the gossip-block cutoff (4s) is still ahead
+        slo.configure_slo(genesis_time=time.time() - 2.0, seconds_per_slot=12)
+        from lodestar_tpu.scheduler import PriorityClass
+
+        js = slo.job_begin(PriorityClass.GOSSIP_BLOCK, slot=0)
+        slo.job_verdict(js, True)
+        out = client._req("GET", "/eth/v0/debug/slo")["data"]
+        assert out["enabled"] is True
+        assert out["deadline_model"]["seconds_per_slot"] == 12
+        cls = out["classes"]["gossip_block"]
+        assert set(cls["legs"]) == {"buffer", "queue", "stage", "launch"}
+        assert cls["sli"] == {"good": 1, "total": 1, "miss": 0}
+        assert "slack_s" in out["now"]
+    finally:
+        slo.reset_slo()

@@ -1,0 +1,173 @@
+"""Process-global SLO accountant: lifecycle hooks, the telescoping
+wait-budget legs, once-per-job SLI accounting, the slack floor, and
+the lodestar_slo_* metric families on a real registry."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from lodestar_tpu import slo
+from lodestar_tpu.metrics import create_metrics
+from lodestar_tpu.scheduler import PriorityClass
+
+GENESIS = 1_600_000_000.0
+SPS = 12
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    slo.reset_slo()
+    yield
+    slo.reset_slo()
+
+
+class FakeClock:
+    def __init__(self, t: float):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _configure(now: float = GENESIS + 1.0, **kw) -> FakeClock:
+    clk = FakeClock(now)
+    slo.configure_slo(
+        genesis_time=GENESIS, seconds_per_slot=SPS, time_fn=clk, **kw
+    )
+    return clk
+
+
+def test_unconfigured_hooks_cost_one_none_check():
+    assert not slo.slo_active()
+    assert slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0) is None
+    # every downstream hook tolerates the None job
+    slo.job_flushed(None)
+    slo.job_dequeued(None)
+    slo.job_launch(None)
+    slo.job_verdict(None, True)
+    assert slo.slack_ms(PriorityClass.API) is None
+    assert slo.slow_slot_slack() == {}
+    assert slo.wait_budget()["enabled"] is False
+    assert slo.wait_budget()["classes"] == {}
+
+
+def test_enabled_without_genesis_stays_inactive():
+    slo.configure_slo(enabled=True, genesis_time=None)
+    assert not slo.slo_active()
+    slo.configure_slo(enabled=False, genesis_time=GENESIS)
+    assert not slo.slo_active()
+
+
+def test_legs_telescope_to_end_to_end():
+    """The acceptance bound: the four legs are computed from the SAME
+    monotonic stamps end-to-end uses, so their sum tracks the measured
+    added→verdict mean within 10% (here: exactly, one job)."""
+    _configure()
+    js = slo.job_begin(PriorityClass.GOSSIP_BLOCK, slot=0)
+    assert js is not None
+    time.sleep(0.004)  # buffer wait
+    slo.job_flushed(js)
+    time.sleep(0.006)  # queue wait
+    slo.job_dequeued(js, waited_ns=6_000_000)
+    time.sleep(0.003)  # staging
+    slo.job_launch(js)
+    time.sleep(0.008)  # device leg
+    slo.job_verdict(js, True)
+
+    cls = slo.wait_budget()["classes"]["gossip_block"]
+    legs = cls["legs"]
+    for leg, floor_ms in (("buffer", 4), ("queue", 6), ("stage", 3), ("launch", 8)):
+        assert legs[leg]["count"] == 1
+        assert legs[leg]["mean_ms"] >= floor_ms * 0.5
+    e2e = cls["end_to_end"]["mean_ms"]
+    assert e2e >= 20
+    assert abs(cls["leg_sum_mean_ms"] - e2e) / e2e < 0.10
+    assert cls["sli"] == {"good": 1, "total": 1, "miss": 0}
+
+
+def test_unbuffered_job_collapses_early_legs_to_zero():
+    _configure()
+    js = slo.job_begin(PriorityClass.API)
+    time.sleep(0.002)
+    slo.job_verdict(js, True)
+    cls = slo.wait_budget()["classes"]["api"]
+    # no flush/dequeue/launch stamps: everything lands in the launch leg
+    assert cls["legs"]["buffer"]["mean_ms"] == 0.0
+    assert cls["legs"]["queue"]["mean_ms"] == 0.0
+    assert cls["legs"]["stage"]["mean_ms"] == 0.0
+    assert cls["legs"]["launch"]["mean_ms"] > 0.0
+    assert abs(cls["leg_sum_mean_ms"] - cls["end_to_end"]["mean_ms"]) <= max(
+        0.1 * cls["end_to_end"]["mean_ms"], 0.01
+    )
+
+
+def test_verdict_is_idempotent_per_job():
+    """The pool hooks the job future's done-callback (fires once), and
+    the `done` flag is the belt-and-braces: a double call must not
+    double-count the SLI."""
+    _configure()
+    js = slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0)
+    slo.job_verdict(js, True)
+    slo.job_verdict(js, True)
+    slo.job_verdict(js, False)
+    sli = slo.wait_budget()["classes"]["gossip_block"]["sli"]
+    assert sli == {"good": 1, "total": 1, "miss": 0}
+
+
+def test_miss_and_floor_semantics():
+    clk = _configure(now=GENESIS + 1.0, slack_floor_ms=500.0)
+    # slot-0 gossip block deadline = genesis + 4s
+    # 1) verdict at +1s: slack 3s >= floor -> good
+    slo.job_verdict(slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0), True)
+    # 2) verdict at +3.8s: slack 0.2s, positive but under the 0.5s floor
+    #    -> counted as a miss, not good
+    clk.t = GENESIS + 3.8
+    slo.job_verdict(slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0), True)
+    # 3) verdict at +5s: slack negative -> miss
+    clk.t = GENESIS + 5.0
+    slo.job_verdict(slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0), True)
+    # 4) invalid signature inside the deadline: total++, not good, not
+    #    a deadline miss (the job FAILED, it wasn't late)
+    clk.t = GENESIS + 1.5
+    slo.job_verdict(slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0), False)
+    sli = slo.wait_budget()["classes"]["gossip_block"]["sli"]
+    assert sli == {"good": 1, "total": 4, "miss": 2}
+
+
+def test_metric_families_on_a_real_registry():
+    metrics = create_metrics()
+    clk = _configure(metrics=metrics.slo)
+    # two classes, one of them with a blown deadline
+    slo.job_verdict(slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0), True)
+    slo.job_verdict(slo.job_begin(PriorityClass.API, None), True)
+    clk.t = GENESIS + 50.0  # long past slot 0's block cutoff
+    slo.job_verdict(slo.job_begin(PriorityClass.GOSSIP_BLOCK, 0), True)
+    text = metrics.scrape().decode()
+    # slack histogram: samples for >=2 classes, all three stages
+    assert 'lodestar_slo_slack_seconds_count{class="gossip_block",stage="verdict"} 2.0' in text
+    assert 'lodestar_slo_slack_seconds_count{class="api",stage="verdict"} 1.0' in text
+    assert 'stage="enqueue"' in text
+    # SLI pair + miss counter
+    assert 'lodestar_slo_sli_total{class="gossip_block"} 2.0' in text
+    assert 'lodestar_slo_sli_good_total{class="gossip_block"} 1.0' in text
+    assert 'lodestar_slo_deadline_miss_total{class="gossip_block"} 1.0' in text
+
+
+def test_slow_slot_slack_snapshot_and_debug_view():
+    _configure(now=GENESIS + 2.0)
+    snap = slo.slow_slot_slack()
+    assert snap["slot"] == 0
+    assert snap["slack_s"]["gossip_block"] == pytest.approx(SPS / 3 - 2.0, abs=1e-3)
+    assert snap["slack_s"]["backfill"] == pytest.approx(32 * SPS - 2.0, abs=1e-3)
+    view = slo.debug_view()
+    assert view["now"] == snap
+    assert view["deadline_model"]["genesis_time"] == GENESIS
+    assert view["deadline_model"]["deadline_fractions"]["gossip_block"] == pytest.approx(1 / 3)
+
+
+def test_slack_ms_span_attribute():
+    _configure(now=GENESIS + 1.0)
+    v = slo.slack_ms(PriorityClass.GOSSIP_BLOCK, 0)
+    assert v == pytest.approx((SPS / 3 - 1.0) * 1000.0, abs=1.0)

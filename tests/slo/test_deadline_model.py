@@ -1,0 +1,99 @@
+"""SlotDeadlineModel: genesis-anchored per-class deadline math under a
+deterministic clock — including slots on and across epoch (fork)
+boundaries, where the anchor must stay genesis_time + slot * spt with
+no per-epoch drift."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.slo import DEADLINE_FRACTIONS, SlotDeadlineModel
+
+GENESIS = 1_600_000_000.0
+SPS = 12
+
+
+def model(now: float, **kw) -> SlotDeadlineModel:
+    return SlotDeadlineModel(
+        genesis_time=GENESIS, seconds_per_slot=SPS, time_fn=lambda: now, **kw
+    )
+
+
+def test_current_slot_tracks_wall_clock():
+    assert model(GENESIS).current_slot == 0
+    assert model(GENESIS + 11.9).current_slot == 0
+    assert model(GENESIS + 12.0).current_slot == 1
+    assert model(GENESIS + 12 * 777 + 3).current_slot == 777
+
+
+def test_pre_genesis_clamps_to_slot_zero():
+    m = model(GENESIS - 100)
+    assert m.current_slot == 0
+    # slack before genesis is the whole wait plus the class budget
+    assert m.slack_s(PriorityClass.GOSSIP_BLOCK) == pytest.approx(100 + SPS / 3)
+
+
+def test_deadline_fractions_order_matches_the_validator_timeline():
+    m = model(GENESIS)
+    deadlines = [m.deadline_for(c, 0) for c in PriorityClass]
+    # gossip block (1/3 slot) < attestation (2/3) < API (1) < sync < backfill
+    assert deadlines == sorted(deadlines)
+    assert m.deadline_for(PriorityClass.GOSSIP_BLOCK, 0) == pytest.approx(GENESIS + SPS / 3)
+    assert m.deadline_for(PriorityClass.GOSSIP_ATTESTATION, 0) == pytest.approx(
+        GENESIS + 2 * SPS / 3
+    )
+    assert m.deadline_for(PriorityClass.API, 0) == pytest.approx(GENESIS + SPS)
+    assert m.deadline_for(PriorityClass.RANGE_SYNC, 0) == pytest.approx(GENESIS + 8 * SPS)
+    assert m.deadline_for(PriorityClass.BACKFILL, 0) == pytest.approx(GENESIS + 32 * SPS)
+
+
+@pytest.mark.parametrize(
+    "slot",
+    [
+        0,
+        31,  # last slot of epoch 0
+        32,  # first slot of epoch 1 (a fork-activation boundary shape)
+        63,
+        64,
+        32 * 74240,  # mainnet altair-fork-scale epoch boundary
+        32 * 144896 + 1,  # just past a bellatrix-scale boundary
+    ],
+)
+@pytest.mark.parametrize("cls", list(PriorityClass))
+def test_deadlines_stay_genesis_anchored_across_epoch_boundaries(slot, cls):
+    """Fork epochs change fork digests, not slot timing: the deadline
+    for any slot in any epoch is genesis + slot*spt + fraction*spt
+    exactly — no accumulation, no per-epoch rounding."""
+    m = model(GENESIS, slots_per_epoch=32)
+    expected = GENESIS + slot * SPS + DEADLINE_FRACTIONS[cls] * SPS
+    assert m.deadline_for(cls, slot) == pytest.approx(expected, abs=1e-6)
+    # slack is the deadline minus the (injected) clock, to the second
+    assert m.slack_s(cls, slot, now=expected - 1.5) == pytest.approx(1.5)
+    assert m.slack_s(cls, slot, now=expected + 0.25) == pytest.approx(-0.25)
+
+
+def test_subject_slot_anchor_vs_wallclock_anchor():
+    """A block FROM slot 5 arriving during slot 7 measures against slot
+    5's cutoff (already blown); slot=None anchors at the current slot."""
+    now = GENESIS + 7 * SPS + 1
+    m = model(now)
+    late = m.slack_s(PriorityClass.GOSSIP_BLOCK, slot=5)
+    assert late < 0  # missed by nearly two slots
+    fresh = m.slack_s(PriorityClass.GOSSIP_BLOCK, slot=None)
+    assert fresh == pytest.approx(SPS / 3 - 1)
+
+
+def test_seconds_per_slot_must_be_positive():
+    with pytest.raises(ValueError, match="seconds_per_slot"):
+        SlotDeadlineModel(genesis_time=0, seconds_per_slot=0)
+
+
+def test_node_options_reject_negative_slack_floor():
+    from lodestar_tpu.node import BeaconNodeOptions
+
+    with pytest.raises(ValueError, match="slo_slack_floor_ms"):
+        BeaconNodeOptions(slo_slack_floor_ms=-1.0)
+    opts = BeaconNodeOptions(slo_slack_floor_ms=250.0, slo_enabled=False)
+    assert opts.slo_slack_floor_ms == 250.0
+    assert opts.slo_enabled is False

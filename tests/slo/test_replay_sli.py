@@ -1,0 +1,192 @@
+"""Seeded replay through the REAL verifier pool with injected latency
+faults (testing/faults.py LATENCY seam): the acceptance shape — nonzero
+lodestar_slo_slack_seconds samples for >=2 priority classes on a real
+registry, the wait-budget legs summing to the measured end-to-end, and
+deadline misses counted exactly once per job even when the RLC batch
+fails and retries each job individually."""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import time
+
+import pytest
+
+from lodestar_tpu import slo
+from lodestar_tpu.chain.bls import BlsDeviceVerifierPool, VerifySignatureOpts
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.metrics import create_metrics
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.testing.faults import FaultInjector, FaultKind, FaultRule
+
+SPS = 12
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    slo.reset_slo()
+    yield
+    slo.reset_slo()
+
+
+def _sets(n: int, tag: int = 0, bad: bool = False) -> list[SignatureSet]:
+    lead = 0xBB if bad else 1
+    return [
+        SignatureSet(
+            pubkey=bytes([lead, tag, i % 256]) + bytes(45),
+            message=bytes([2, tag, i % 256]) * 8 + bytes(8),
+            signature=bytes([3, tag, i % 256]) + bytes(93),
+        )
+        for i in range(n)
+    ]
+
+
+class Backend:
+    """Deterministic verify_fn: per-batch verdict via the bad-set
+    marker (pubkey[0] == 0xBB), call sizes recorded."""
+
+    def __init__(self):
+        self.calls: list[int] = []
+
+    def __call__(self, sets):
+        self.calls.append(len(sets))
+        return not any(s.pubkey[0] == 0xBB for s in sets)
+
+
+def _latency_backend(delay_s: float = 0.01, seed: int = 7):
+    be = Backend()
+    inj = FaultInjector(
+        [
+            FaultRule(
+                FaultKind.LATENCY, delay_s=delay_s, methods=frozenset({"backend"})
+            )
+        ],
+        seed=seed,
+    )
+    return be, inj.wrap_backend(be)
+
+
+def _sample(text: str, name: str, **labels) -> float:
+    sel = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    m = re.search(rf"^{re.escape(name)}{{{re.escape(sel)}}} ([0-9.e+-]+)$", text, re.M)
+    assert m, f"{name}{{{sel}}} not in scrape"
+    return float(m.group(1))
+
+
+def test_replay_emits_slack_samples_for_two_classes():
+    """Gossip-block and API traffic through the pool under injected
+    backend latency: both classes land slack histogram samples and SLI
+    totals on a real registry."""
+    metrics = create_metrics()
+    # mid slot 0, 3s in: gossip-block cutoff (4s) still ahead
+    slo.configure_slo(
+        genesis_time=time.time() - 3.0, seconds_per_slot=SPS, metrics=metrics.slo
+    )
+
+    async def go():
+        _, backend = _latency_backend(delay_s=0.01)
+        pool = BlsDeviceVerifierPool(backend, buffer_wait_ms=5)
+        r1, r2 = await asyncio.gather(
+            pool.verify_signature_sets(
+                _sets(3, 1),
+                VerifySignatureOpts(
+                    batchable=True, priority=PriorityClass.GOSSIP_BLOCK, slot=0
+                ),
+            ),
+            pool.verify_signature_sets(
+                _sets(4, 2),
+                VerifySignatureOpts(batchable=True, priority=PriorityClass.API),
+            ),
+        )
+        assert r1 and r2
+        await asyncio.sleep(0)  # let the verdict done-callbacks run
+        await pool.close()
+
+    asyncio.run(go())
+
+    text = metrics.scrape().decode()
+    for cls in ("gossip_block", "api"):
+        assert (
+            _sample(
+                text, "lodestar_slo_slack_seconds_count", **{"class": cls, "stage": "verdict"}
+            )
+            >= 1.0
+        ), cls
+        assert _sample(text, "lodestar_slo_sli_total", **{"class": cls}) >= 1.0
+    # nothing was late: no misses
+    budget = slo.wait_budget()
+    for cls in ("gossip_block", "api"):
+        assert budget["classes"][cls]["sli"]["miss"] == 0
+
+
+def test_wait_budget_legs_partition_measured_end_to_end():
+    """Acceptance bound, measured through the real pool: per-class leg
+    sum within 10% of the measured end-to-end mean, with the injected
+    backend latency visible in the launch leg."""
+    slo.configure_slo(genesis_time=time.time() - 1.0, seconds_per_slot=SPS)
+
+    async def go():
+        _, backend = _latency_backend(delay_s=0.02)
+        pool = BlsDeviceVerifierPool(backend, buffer_wait_ms=5)
+        await asyncio.gather(
+            *[
+                pool.verify_signature_sets(
+                    _sets(2, t),
+                    VerifySignatureOpts(
+                        batchable=True, priority=PriorityClass.GOSSIP_BLOCK, slot=0
+                    ),
+                )
+                for t in range(4)
+            ]
+        )
+        await asyncio.sleep(0)
+        await pool.close()
+
+    asyncio.run(go())
+
+    cls = slo.wait_budget()["classes"]["gossip_block"]
+    assert cls["end_to_end"]["count"] == 4
+    e2e = cls["end_to_end"]["mean_ms"]
+    assert e2e >= 20.0  # the injected 20ms backend latency is in there
+    assert abs(cls["leg_sum_mean_ms"] - e2e) / e2e < 0.10
+    # the device leg carries the injected latency
+    assert cls["legs"]["launch"]["mean_ms"] >= 15.0
+
+
+def test_misses_counted_once_per_job_across_batch_retry():
+    """A poisoned RLC batch retries each job individually — more
+    backend launches, but the SLI must count each JOB exactly once
+    (total 2, miss 2 when the deadline is already blown), not once per
+    retry attempt."""
+    metrics = create_metrics()
+    # anchor slot 0's cutoffs firmly in the past: every verdict is late
+    slo.configure_slo(
+        genesis_time=time.time() - 10 * SPS, seconds_per_slot=SPS, metrics=metrics.slo
+    )
+
+    async def go():
+        be, backend = _latency_backend(delay_s=0.005)
+        pool = BlsDeviceVerifierPool(backend, buffer_wait_ms=5)
+        opts = VerifySignatureOpts(
+            batchable=True, priority=PriorityClass.GOSSIP_BLOCK, slot=0
+        )
+        r_good, r_bad = await asyncio.gather(
+            pool.verify_signature_sets(_sets(3, 1), opts),
+            pool.verify_signature_sets(_sets(2, 2, bad=True), opts),
+        )
+        assert r_good is True and r_bad is False
+        await asyncio.sleep(0)
+        await pool.close()
+        # the batch failed and retried individually: >= 3 backend calls
+        assert len(be.calls) >= 3, be.calls
+
+    asyncio.run(go())
+
+    sli = slo.wait_budget()["classes"]["gossip_block"]["sli"]
+    assert sli["total"] == 2, sli
+    assert sli["miss"] == 2, sli
+    assert sli["good"] == 0, sli
+    text = metrics.scrape().decode()
+    assert _sample(text, "lodestar_slo_sli_total", **{"class": "gossip_block"}) == 2.0
+    assert _sample(text, "lodestar_slo_deadline_miss_total", **{"class": "gossip_block"}) == 2.0

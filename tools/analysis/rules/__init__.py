@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from .alert_wiring import AlertWiringRule
 from .bench_wiring import BenchWiringRule
 from .blocking_under_lock import BlockingUnderLockRule
 from .fail_closed import FailClosedVerdictsRule
@@ -22,6 +23,7 @@ ALL_RULES = (
     RestRouteWiringRule(),
     FaultWiringRule(),
     BenchWiringRule(),
+    AlertWiringRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

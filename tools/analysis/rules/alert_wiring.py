@@ -1,0 +1,194 @@
+"""alert-wiring: Prometheus alert rules ↔ metric registry, both
+directions — the alerting sibling of the dashboard half of
+`metrics-and-cli-wiring` (same sample-name doctrine: counters surface
+as ``<name>_total``, histograms as ``_bucket``/``_sum``/``_count``).
+
+Project-scoped over two fixed locations: the statically collected
+metric families under ``lodestar_tpu/`` and the committed rule files
+under ``alerts/*.yml`` (JSON content — JSON is a YAML subset, written
+by ``tools/gen_alerts.py``; parsed here with ``json.loads``, so the
+checker stays dependency-free).
+
+Checks:
+
+1. **alerts → registry**: every metric-shaped token in an alert
+   ``expr`` must be a sample name derivable from a registered family —
+   an alert over a sample nobody exposes is a rule that can never
+   fire, which reads as "we are covered" during exactly the incident
+   it was written for.
+2. **registry → alerts**: every ``lodestar_slo_*`` family must be
+   referenced by at least one alert expr, or carry an
+   ``UNALERTED_ALLOWLIST`` entry with a reason. Scoped to the SLO
+   families on purpose: they exist to page someone — an SLI pair or
+   miss counter no rule reads is a silent pager. (General families are
+   covered by the dashboard direction of `metrics-and-cli-wiring`;
+   forcing an alert per family would manufacture alert spam.)
+   Allowlist entries naming no registered family are flagged as stale.
+3. **rule hygiene**: every rule carries a ``severity`` label and a
+   ``summary`` annotation (a page with no severity never routes; a
+   firing alert with no summary is a mystery at 3am), and alert names
+   are unique across all groups.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from ..core import Finding, Rule
+from .wiring import (
+    _GROUP_CLAUSE_RE,
+    _LABEL_SELECTOR_RE,
+    _PROMQL_WORDS,
+    _TOKEN_RE,
+    collect_metric_families,
+)
+
+ALERTS_REL = Path("alerts")
+#: family-name prefix whose members MUST be alerted (or allowlisted):
+#: the SLO families exist precisely to drive the burn-rate rules
+ALERTED_PREFIX = "lodestar_slo_"
+
+#: lodestar_slo_* families intentionally carrying no alert rule yet;
+#: every entry needs the reason no pager reads it today.
+UNALERTED_ALLOWLIST: dict[str, str] = {}
+
+
+def _allowlist_line(name: str) -> int:
+    for i, line in enumerate(Path(__file__).read_text(encoding="utf-8").splitlines(), 1):
+        if f'"{name}"' in line:
+            return i
+    return 1
+
+
+def alert_expr_tokens(expr: str) -> set:
+    """Metric-shaped tokens in a PromQL expr — label selectors and
+    by/without grouping clauses stripped first (they hold LABEL names,
+    not sample names), same tokenizer as the dashboard check."""
+    expr = _LABEL_SELECTOR_RE.sub("", expr)
+    expr = _GROUP_CLAUSE_RE.sub("", expr)
+    return {
+        tok
+        for tok in _TOKEN_RE.findall(expr)
+        if "_" in tok and tok not in _PROMQL_WORDS
+    }
+
+
+def _iter_rules(doc):
+    for group in doc.get("groups", []) or []:
+        for rule in group.get("rules", []) or []:
+            if isinstance(rule, dict):
+                yield rule
+
+
+class AlertWiringRule(Rule):
+    name = "alert-wiring"
+    description = (
+        "alert rule exprs resolve to registered metric samples, every "
+        "lodestar_slo_* family is alerted (or allowlisted with a "
+        "reason), and rules carry severity + summary"
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        findings: list[Finding] = []
+        pkg = repo_root / "lodestar_tpu"
+        alerts_dir = repo_root / ALERTS_REL
+        if not pkg.is_dir() or not alerts_dir.is_dir():
+            return findings  # tree without the alert tooling: nothing to wire
+
+        fams = collect_metric_families(pkg, sources=sources)
+        sample_names: set = set()
+        for fam in fams:
+            sample_names.update(fam.samples())
+
+        all_tokens: set = set()
+        seen_alert_names: dict[str, str] = {}
+        for path in sorted(alerts_dir.glob("*.yml")):
+            try:
+                doc = json.loads(path.read_text(encoding="utf-8"))
+            except ValueError:
+                findings.append(
+                    Finding(
+                        self.name, str(path), 1,
+                        "rule file is not the JSON-content YAML "
+                        "tools/gen_alerts.py writes — regenerate it",
+                    )
+                )
+                continue
+            for rule in _iter_rules(doc):
+                alert = rule.get("alert", "<unnamed>")
+                # hygiene first — a broken rule should surface every
+                # problem in one pass
+                if not isinstance(rule.get("labels"), dict) or "severity" not in rule["labels"]:
+                    findings.append(
+                        Finding(
+                            self.name, str(path), 1,
+                            f"alert '{alert}' has no severity label — it "
+                            "can never route to a pager or a ticket queue",
+                        )
+                    )
+                if (
+                    not isinstance(rule.get("annotations"), dict)
+                    or "summary" not in rule["annotations"]
+                ):
+                    findings.append(
+                        Finding(
+                            self.name, str(path), 1,
+                            f"alert '{alert}' has no summary annotation",
+                        )
+                    )
+                if alert in seen_alert_names:
+                    findings.append(
+                        Finding(
+                            self.name, str(path), 1,
+                            f"alert name '{alert}' is duplicated (also in "
+                            f"{seen_alert_names[alert]}) — Alertmanager "
+                            "dedup would merge distinct conditions",
+                        )
+                    )
+                else:
+                    seen_alert_names[alert] = str(path)
+                tokens = alert_expr_tokens(rule.get("expr", ""))
+                all_tokens.update(tokens)
+                for tok in sorted(tokens - sample_names):
+                    findings.append(
+                        Finding(
+                            self.name, str(path), 1,
+                            f"alert '{alert}' expr references '{tok}' which "
+                            "no registered metric family can expose "
+                            "(counters surface as <name>_total, histograms "
+                            "as _bucket/_sum/_count) — the rule can never "
+                            "fire",
+                        )
+                    )
+
+        # registry → alerts, scoped to the SLO families
+        seen: set = set()
+        for fam in fams:
+            if not fam.name.startswith(ALERTED_PREFIX) or fam.name in seen:
+                continue
+            seen.add(fam.name)
+            if fam.name in UNALERTED_ALLOWLIST:
+                continue
+            if not (fam.samples() & all_tokens):
+                findings.append(
+                    Finding(
+                        self.name, fam.path, fam.line,
+                        f"SLO metric family '{fam.name}' ({fam.kind}) is "
+                        "read by no alert rule — add a rule to "
+                        "tools/gen_alerts.py or an UNALERTED_ALLOWLIST "
+                        "entry with a reason",
+                    )
+                )
+        registered = {f.name for f in fams}
+        for name in sorted(UNALERTED_ALLOWLIST):
+            if name not in registered:
+                findings.append(
+                    Finding(
+                        self.name, __file__, _allowlist_line(name),
+                        f"UNALERTED_ALLOWLIST entry '{name}' names no "
+                        "registered metric family — remove the stale entry",
+                    )
+                )
+        return findings

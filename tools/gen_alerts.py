@@ -1,0 +1,298 @@
+"""Generate the Prometheus alert rules under alerts/ (multi-window
+multi-burn-rate SLO alerts over the lodestar_slo_* SLI pairs, plus the
+deadline/slack and standing health alerts).
+
+The committed file is `alerts/lodestar_alerts.yml` — JSON content
+(JSON is a YAML subset, so promtool/Prometheus load it unmodified)
+written with sort_keys so regeneration is byte-stable; the
+regen-is-noop test and `--check` diff it exactly, the same doctrine as
+tools/gen_dashboards.py.
+
+Every expr is validated AT GENERATION TIME against the statically
+collected metric registry (the same Family/sample-name derivation the
+`metrics-and-cli-wiring` and `alert-wiring` analysis rules use:
+counters surface as <name>_total, histograms as _bucket/_sum/_count) —
+an alert naming a sample no family can expose is a generation error,
+not a silently-dead rule.
+
+Burn-rate windows follow the multi-window multi-burn-rate recipe: a
+page fires only when BOTH a short and a long window burn the error
+budget at 14.4x (fast: 5m + 1h — budget gone in ~2 days), a ticket at
+6x (slow: 30m + 6h — gone in ~5 days). The short window makes the
+alert reset quickly once the burn stops; the long window keeps a brief
+blip from paging.
+
+Run from the repo root: python tools/gen_alerts.py  [--check]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+OUT = "alerts"
+RULES_FILE = "lodestar_alerts.yml"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: SLO availability target for the verification SLI (good verdicts
+#: inside the class deadline / total verdicts): 99.9% → an error
+#: budget of 0.1% of jobs per window
+SLO_TARGET = 0.999
+ERROR_BUDGET = 1.0 - SLO_TARGET
+
+#: (tier, short window, long window, burn-rate factor, severity)
+BURN_WINDOWS = (
+    ("fast", "5m", "1h", 14.4, "page"),
+    ("slow", "30m", "6h", 6.0, "ticket"),
+)
+
+
+def _error_ratio(window: str) -> str:
+    """Per-class SLI error ratio over `window`: 1 - good/total, grouped
+    by class so the firing alert names WHICH deadline class burns."""
+    return (
+        "(1 - (sum by (class) (rate(lodestar_slo_sli_good_total[{w}])) "
+        "/ sum by (class) (rate(lodestar_slo_sli_total[{w}]))))"
+    ).format(w=window)
+
+
+def burn_rate_rules():
+    rules = []
+    for tier, short, long_, factor, severity in BURN_WINDOWS:
+        threshold = round(factor * ERROR_BUDGET, 6)
+        rules.append(
+            {
+                "alert": f"LodestarSloBurnRate{tier.capitalize()}",
+                "expr": (
+                    f"{_error_ratio(short)} > {threshold} and "
+                    f"{_error_ratio(long_)} > {threshold}"
+                ),
+                "for": "2m" if tier == "fast" else "15m",
+                "labels": {"severity": severity, "slo": "verify-deadline"},
+                "annotations": {
+                    "summary": (
+                        f"{tier} burn: class {{{{ $labels.class }}}} is "
+                        f"burning the {SLO_TARGET:.1%} verify-deadline "
+                        f"error budget at >{factor}x over both {short} "
+                        f"and {long_} windows"
+                    ),
+                    "runbook": (
+                        "check the slack dashboard (lodestar_slo.json): "
+                        "which wait-budget leg grew — buffer/queue legs "
+                        "point at admission or batch-former pressure, "
+                        "launch leg at device/compile trouble"
+                    ),
+                },
+            }
+        )
+    return rules
+
+
+def deadline_rules():
+    return [
+        {
+            # gossip blocks missing the attestation cutoff is the
+            # highest-stakes miss the node can produce: page on ANY
+            # sustained rate
+            "alert": "LodestarGossipBlockDeadlineMiss",
+            "expr": (
+                'sum(rate(lodestar_slo_deadline_miss_total'
+                '{class="gossip_block"}[5m])) > 0'
+            ),
+            "for": "2m",
+            "labels": {"severity": "page", "slo": "verify-deadline"},
+            "annotations": {
+                "summary": (
+                    "gossip-block verifications are missing the 1/3-slot "
+                    "attestation cutoff (sustained over 5m)"
+                ),
+                "runbook": (
+                    "GET /eth/v0/debug/slo for the per-class wait-budget "
+                    "decomposition; slow-slot dumps carry per-class slack "
+                    "at dump time"
+                ),
+            },
+        },
+        {
+            "alert": "LodestarDeadlineMissElevated",
+            "expr": (
+                "sum by (class) "
+                "(rate(lodestar_slo_deadline_miss_total[30m])) > 0.1"
+            ),
+            "for": "15m",
+            "labels": {"severity": "ticket", "slo": "verify-deadline"},
+            "annotations": {
+                "summary": (
+                    "class {{ $labels.class }} misses its slot deadline "
+                    ">0.1/s over 30m"
+                ),
+                "runbook": "read the slack histogram by stage: slack already "
+                "negative at enqueue means upstream (gossip/sync) delivery "
+                "is late, slack lost between dispatch and verdict means the "
+                "verify path is slow",
+            },
+        },
+        {
+            # leading indicator: the fraction of verdicts landing with
+            # slack already negative (le="0.0" bucket of the slack
+            # histogram) — fires before the SLI pair degrades enough to
+            # burn budget
+            "alert": "LodestarSlackExhausted",
+            "expr": (
+                'sum by (class) (rate(lodestar_slo_slack_seconds_bucket'
+                '{le="0.0",stage="verdict"}[10m])) / sum by (class) '
+                "(rate(lodestar_slo_slack_seconds_count"
+                '{stage="verdict"}[10m])) > 0.05'
+            ),
+            "for": "10m",
+            "labels": {"severity": "ticket", "slo": "verify-deadline"},
+            "annotations": {
+                "summary": (
+                    ">5% of class {{ $labels.class }} verdicts land with "
+                    "zero or negative deadline slack"
+                ),
+                "runbook": "compare the enqueue-stage slack histogram: if "
+                "enqueue slack is healthy the budget is being spent inside "
+                "this process (wait-budget profiler names the leg)",
+            },
+        },
+    ]
+
+
+def health_rules():
+    """Standing health alerts over the pre-SLO families: the conditions
+    an operator already watches on the dashboards, promoted to rules."""
+    return [
+        {
+            "alert": "LodestarOffloadBreakerOpen",
+            "expr": "max by (endpoint) (lodestar_resilience_breaker_state) == 2",
+            "for": "5m",
+            "labels": {"severity": "ticket"},
+            "annotations": {
+                "summary": (
+                    "offload endpoint {{ $labels.endpoint }} breaker open "
+                    "for 5m — verifications are riding the fallback chain"
+                ),
+                "runbook": "lodestar_offload_resilience.json: failover and "
+                "degradation-chain panels",
+            },
+        },
+        {
+            "alert": "LodestarMeshLanesExhausted",
+            "expr": "lodestar_sched_mesh_lanes_available == 0",
+            "for": "5m",
+            "labels": {"severity": "page"},
+            "annotations": {
+                "summary": "no non-wedged mesh lanes for 5m — every verify "
+                "chip is wedged or breaker-tripped",
+                "runbook": "lodestar_mesh_serving.json: per-chip wedge trips",
+            },
+        },
+        {
+            "alert": "LodestarEventLoopLagHigh",
+            "expr": (
+                "histogram_quantile(0.95, "
+                "rate(lodestar_event_loop_lag_seconds_bucket[5m])) > 0.5"
+            ),
+            "for": "10m",
+            "labels": {"severity": "ticket"},
+            "annotations": {
+                "summary": "event-loop scheduling lag p95 >500ms — loop "
+                "starvation will show up as buffer/queue wait in the SLO "
+                "decomposition",
+                "runbook": "lodestar_node_internals.json: event loop panel",
+            },
+        },
+        {
+            "alert": "LodestarSlowSlotStorm",
+            "expr": "rate(lodestar_trace_slow_slot_total[10m]) > 0.05",
+            "for": "10m",
+            "labels": {"severity": "ticket"},
+            "annotations": {
+                "summary": "slow-slot dumps firing >3/min over 10m",
+                "runbook": "read the exported dumps — each names its device "
+                "launches and per-class deadline slack inline",
+            },
+        },
+    ]
+
+
+def alert_doc():
+    return {
+        "groups": [
+            {"name": "lodestar-slo-burn-rate", "rules": burn_rate_rules()},
+            {"name": "lodestar-slo-deadline", "rules": deadline_rules()},
+            {"name": "lodestar-health", "rules": health_rules()},
+        ]
+    }
+
+
+def validate_against_registry(doc) -> list:
+    """Every metric-shaped token in every alert expr must be a sample
+    name derivable from a registered family — the generation-time twin
+    of the alert-wiring analysis rule."""
+    from tools.analysis.rules.wiring import (
+        _GROUP_CLAUSE_RE,
+        _LABEL_SELECTOR_RE,
+        _PROMQL_WORDS,
+        _TOKEN_RE,
+        collect_metric_families,
+    )
+    from pathlib import Path
+
+    fams = collect_metric_families(Path(REPO) / "lodestar_tpu")
+    samples = set()
+    for fam in fams:
+        samples.update(fam.samples())
+    errors = []
+    for group in doc["groups"]:
+        for rule in group["rules"]:
+            expr = _LABEL_SELECTOR_RE.sub("", rule["expr"])
+            expr = _GROUP_CLAUSE_RE.sub("", expr)
+            for tok in _TOKEN_RE.findall(expr):
+                if "_" in tok and tok not in _PROMQL_WORDS and tok not in samples:
+                    errors.append(f"{rule['alert']}: unknown sample '{tok}'")
+    return errors
+
+
+def render() -> str:
+    doc = alert_doc()
+    errors = validate_against_registry(doc)
+    if errors:
+        raise SystemExit("gen_alerts: exprs name unregistered samples:\n  " + "\n  ".join(errors))
+    # sort_keys keeps the output byte-stable across dict-build order
+    # changes, so --check and the regen-is-noop test can diff exactly
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def main(out: str = OUT, check: bool = False) -> int:
+    text = render()
+    path = os.path.join(out, RULES_FILE)
+    if check:
+        try:
+            with open(path) as f:
+                committed = f.read()
+        except OSError:
+            print(f"{path} missing — run: python tools/gen_alerts.py")
+            return 1
+        if committed != text:
+            print(f"{path} is stale — run: python tools/gen_alerts.py")
+            return 1
+        return 0
+    os.makedirs(out, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--check", action="store_true",
+        help="diff against the committed rules instead of writing (exit 1 on drift)",
+    )
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args()
+    raise SystemExit(main(out=args.out, check=args.check))

@@ -584,6 +584,102 @@ def device_launches_dashboard():
     )
 
 
+def slo_dashboard():
+    """Slot-deadline SLO (lodestar_tpu/slo): per-class remaining-slack
+    distributions at enqueue/dispatch/verdict, deadline-miss rates, the
+    good/total SLI availability ratio and its error-budget burn rate
+    (the panels behind alerts/lodestar_alerts.yml), and the offload
+    host's per-tenant serving slack. The "are verdicts landing inside
+    the slot, and if not where did the budget go" dashboard — the
+    per-leg wait decomposition lives at GET /eth/v0/debug/slo."""
+    ps = [
+        panel(
+            # p05, not p50: the SLO question is the worst-case tail —
+            # "how close to the cliff are the slowest verdicts"
+            "Verdict slack p05 by class (s left at the cutoff)",
+            [
+                (
+                    "histogram_quantile(0.05, sum by (class, le) "
+                    '(rate(lodestar_slo_slack_seconds_bucket{stage="verdict"}[5m])))',
+                    "{{class}}",
+                ),
+            ],
+            unit="s", pid=1,
+        ),
+        panel(
+            # enqueue vs verdict medians: slack lost BETWEEN the stages
+            # is spent inside this process (the wait-budget legs);
+            # slack already negative at enqueue is upstream lateness
+            "Slack p50 by stage (where the budget goes)",
+            [
+                (
+                    "histogram_quantile(0.5, sum by (stage, le) "
+                    "(rate(lodestar_slo_slack_seconds_bucket[5m])))",
+                    "{{stage}}",
+                ),
+            ],
+            unit="s", x=12, pid=2,
+        ),
+        panel(
+            "Deadline misses by class",
+            [
+                (
+                    "sum by (class) (rate(lodestar_slo_deadline_miss_total[5m]))",
+                    "{{class}}",
+                ),
+            ],
+            unit="ops", y=8, pid=3,
+        ),
+        panel(
+            "SLI availability (good/total) by class",
+            [
+                (
+                    "sum by (class) (rate(lodestar_slo_sli_good_total[5m])) / "
+                    "sum by (class) (rate(lodestar_slo_sli_total[5m]))",
+                    "{{class}}",
+                ),
+            ],
+            unit="percentunit", x=12, y=8, pid=4,
+        ),
+        panel(
+            # burn rate in budget multiples (1.0 = exactly on target,
+            # 14.4 = the fast-burn page threshold): the live view of
+            # the alert pair in alerts/lodestar_alerts.yml
+            "Error-budget burn rate (x budget, 99.9% target)",
+            [
+                (
+                    "(1 - (sum(rate(lodestar_slo_sli_good_total[5m])) / "
+                    "sum(rate(lodestar_slo_sli_total[5m])))) / 0.001",
+                    "5m window",
+                ),
+                (
+                    "(1 - (sum(rate(lodestar_slo_sli_good_total[1h])) / "
+                    "sum(rate(lodestar_slo_sli_total[1h])))) / 0.001",
+                    "1h window",
+                ),
+            ],
+            y=16, pid=5,
+        ),
+        panel(
+            "Offload host: per-tenant serving slack p05",
+            [
+                (
+                    "histogram_quantile(0.05, sum by (tenant, le) "
+                    "(rate(lodestar_offload_tenant_slack_seconds_bucket[5m])))",
+                    "{{tenant}}",
+                ),
+            ],
+            unit="s", x=12, y=16, pid=6,
+        ),
+    ]
+    return dashboard(
+        "lodestar-slo",
+        "Lodestar TPU - Slot-deadline SLO",
+        ps,
+        ["lodestar", "slo"],
+    )
+
+
 def all_dashboards():
     return (
         ("lodestar_bls_verifier_pool.json", bls_pool()),
@@ -601,6 +697,7 @@ def all_dashboards():
         ("lodestar_node_internals.json", node_internals_dashboard()),
         ("lodestar_mesh_serving.json", mesh_serving_dashboard()),
         ("lodestar_device_launches.json", device_launches_dashboard()),
+        ("lodestar_slo.json", slo_dashboard()),
     )
 
 

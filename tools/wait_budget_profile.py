@@ -1,0 +1,144 @@
+"""Wait-budget profiler: render the per-class latency decomposition
+behind `GET /eth/v0/debug/slo` as an operator-readable table.
+
+The SLO accountant (lodestar_tpu/slo) partitions every verification
+job's added→verdict wall time into four telescoping monotonic legs —
+
+    buffer  (added → batch-former flush)
+    queue   (flush → scheduler dequeue)
+    stage   (dequeue → device launch)
+    launch  (launch → verdict)
+
+— so the legs SUM to the measured end-to-end by construction, and the
+profile answers "which leg is eating the slot budget" per priority
+class, next to the remaining-slack distribution and the SLI good/total
+pair.
+
+Sources (exactly one):
+
+  --url http://127.0.0.1:9596   fetch the live node's debug route
+  --in dump.json                a saved response (or its "data" value)
+
+Options: --out FILE writes the raw decomposition JSON next to the
+table (for diffing two runs); exit status is nonzero when any class's
+leg sum disagrees with the measured end-to-end mean by more than
+--tolerance (default 10%) — the accountant's partition invariant,
+checkable from the outside.
+
+Stdlib-only (urllib), same doctrine as the module it profiles.
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+LEGS = ("buffer", "queue", "stage", "launch")
+
+
+def fetch(url: str) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/eth/v0/debug/slo", timeout=10) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fmt_ms(v) -> str:
+    return f"{v:9.3f}" if isinstance(v, (int, float)) else f"{'-':>9}"
+
+
+def render(budget: dict, tolerance: float) -> tuple[str, list]:
+    """(table text, list of classes violating the partition tolerance)."""
+    lines = []
+    violations = []
+    if not budget.get("enabled"):
+        lines.append("SLO accounting is disabled on this node (--slo-disable,")
+        lines.append("or no genesis time yet) — no decomposition to profile.")
+        return "\n".join(lines) + "\n", violations
+    dm = budget.get("deadline_model") or {}
+    lines.append(
+        "deadline model: genesis={g} seconds_per_slot={s} slack_floor={f}ms".format(
+            g=dm.get("genesis_time"), s=dm.get("seconds_per_slot"),
+            f=budget.get("slack_floor_ms"),
+        )
+    )
+    classes = budget.get("classes") or {}
+    if not classes:
+        lines.append("no verification jobs observed yet")
+        return "\n".join(lines) + "\n", violations
+    hdr = f"{'class':<20}{'leg':<8}{'p50 ms':>9}{'p90 ms':>9}{'p99 ms':>9}{'mean ms':>9}{'n':>7}"
+    for cls in sorted(classes):
+        c = classes[cls]
+        lines.append("")
+        lines.append(hdr)
+        for leg in LEGS:
+            q = (c.get("legs") or {}).get(leg) or {}
+            lines.append(
+                f"{cls:<20}{leg:<8}"
+                f"{_fmt_ms(q.get('p50_ms'))}{_fmt_ms(q.get('p90_ms'))}"
+                f"{_fmt_ms(q.get('p99_ms'))}{_fmt_ms(q.get('mean_ms'))}"
+                f"{q.get('count', 0):>7}"
+            )
+        e2e = c.get("end_to_end") or {}
+        lines.append(
+            f"{cls:<20}{'e2e':<8}"
+            f"{_fmt_ms(e2e.get('p50_ms'))}{_fmt_ms(e2e.get('p90_ms'))}"
+            f"{_fmt_ms(e2e.get('p99_ms'))}{_fmt_ms(e2e.get('mean_ms'))}"
+            f"{e2e.get('count', 0):>7}"
+        )
+        # recompute the sum from the per-leg means — trusting the
+        # server's leg_sum_mean_ms would make the partition check a
+        # tautology, not an outside verification
+        leg_means = [((c.get("legs") or {}).get(leg) or {}).get("mean_ms") for leg in LEGS]
+        if all(isinstance(v, (int, float)) for v in leg_means):
+            leg_sum = sum(leg_means)
+        else:
+            leg_sum = c.get("leg_sum_mean_ms")
+        e2e_mean = e2e.get("mean_ms")
+        if isinstance(leg_sum, (int, float)) and isinstance(e2e_mean, (int, float)):
+            drift = abs(leg_sum - e2e_mean) / e2e_mean if e2e_mean else 0.0
+            flag = ""
+            if drift > tolerance:
+                violations.append(cls)
+                flag = f"  << legs do not partition e2e (>{tolerance:.0%})"
+            lines.append(
+                f"{cls:<20}{'sum':<8}{'':>27}{_fmt_ms(leg_sum)}"
+                f"{'':>7}  (drift {drift:.1%}){flag}"
+            )
+        sli = c.get("sli") or {}
+        lines.append(
+            f"{cls:<20}sli     good={sli.get('good', 0)} "
+            f"total={sli.get('total', 0)} miss={sli.get('miss', 0)}"
+        )
+    return "\n".join(lines) + "\n", violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="beacon REST base, e.g. http://127.0.0.1:9596")
+    src.add_argument("--in", dest="infile", help="saved /eth/v0/debug/slo response")
+    ap.add_argument("--out", help="write the raw decomposition JSON here")
+    ap.add_argument(
+        "--tolerance", type=float, default=0.10,
+        help="max |leg sum - e2e mean| / e2e mean before nonzero exit (0.10)",
+    )
+    args = ap.parse_args(argv)
+
+    doc = fetch(args.url) if args.url else load(args.infile)
+    budget = doc.get("data", doc)  # accept the route envelope or the bare value
+    text, violations = render(budget, args.tolerance)
+    sys.stdout.write(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(budget, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
