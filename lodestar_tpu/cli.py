@@ -137,10 +137,19 @@ def _add_scheduler_args(sp) -> None:
     )
     from lodestar_tpu.offload.resilience import (
         DEFAULT_FAILURE_THRESHOLD,
+        DEFAULT_HEDGE_DELAY_MS,
         DEFAULT_MAX_RESET_TIMEOUT_S,
         DEFAULT_RESET_TIMEOUT_S,
     )
 
+    sp.add_argument(
+        "--offload-hedge-delay-ms", type=float, default=None, metavar="MS",
+        help="fire a concurrent hedge RPC to a second offload endpoint when "
+        "the primary has not answered within this many milliseconds (first "
+        "verdict wins, the loser is discarded; needs >= 2 endpoints; "
+        f"0 or omitted = sequential split-budget retry; {DEFAULT_HEDGE_DELAY_MS:g} "
+        "is the chaos-harness-tuned default — see TUNING.md)",
+    )
     sp.add_argument(
         "--offload-breaker-threshold", type=int, default=DEFAULT_FAILURE_THRESHOLD,
         help="consecutive verify failures before an offload endpoint's circuit "
@@ -397,6 +406,7 @@ async def _run_dev(args) -> int:
             offload_endpoints=args.bls_offload,
             offload_breaker_threshold=args.offload_breaker_threshold,
             offload_breaker_reset_s=args.offload_breaker_reset_sec,
+            offload_hedge_delay_ms=args.offload_hedge_delay_ms,
             offload_fallback=args.offload_fallback,
             offload_audit_rate=args.offload_audit_rate,
             offload_audit_budget=args.offload_audit_budget,
@@ -570,6 +580,7 @@ async def _run_beacon(args) -> int:
             offload_endpoints=args.bls_offload,
             offload_breaker_threshold=args.offload_breaker_threshold,
             offload_breaker_reset_s=args.offload_breaker_reset_sec,
+            offload_hedge_delay_ms=args.offload_hedge_delay_ms,
             offload_fallback=args.offload_fallback,
             offload_audit_rate=args.offload_audit_rate,
             offload_audit_budget=args.offload_audit_budget,

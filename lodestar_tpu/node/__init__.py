@@ -45,6 +45,7 @@ class BeaconNodeOptions:
         offload_endpoints: list[str] | None = None,
         offload_breaker_threshold: int | None = None,
         offload_breaker_reset_s: float | None = None,
+        offload_hedge_delay_ms: float | None = None,
         offload_fallback: str = "cpu",
         offload_audit_rate: float | None = None,
         offload_audit_budget: float | None = None,
@@ -101,6 +102,16 @@ class BeaconNodeOptions:
             DEFAULT_RESET_TIMEOUT_S
             if offload_breaker_reset_s is None
             else offload_breaker_reset_s
+        )
+        # true hedged requests: a concurrent second RPC fires when the
+        # primary is silent past this delay (first verdict wins, the
+        # loser's verdict is discarded). None/<=0 = sequential
+        # split-budget retry (the legacy hedge). The shipped default
+        # lives in resilience.py with TUNING.md provenance.
+        self.offload_hedge_delay_ms = (
+            None
+            if offload_hedge_delay_ms is None or offload_hedge_delay_ms <= 0
+            else float(offload_hedge_delay_ms)
         )
         # degradation chain below the offload client: "cpu" (offload →
         # CPU oracle), "device" (offload → local device pool → CPU), or
@@ -407,6 +418,7 @@ class BeaconNode:
                 opts.offload_endpoints,
                 breaker_threshold=opts.offload_breaker_threshold,
                 breaker_reset_s=opts.offload_breaker_reset_s,
+                hedge_delay_ms=opts.offload_hedge_delay_ms,
                 metrics=metrics.resilience,
                 auditor=auditor,
                 quarantine_cooloff_s=opts.offload_quarantine_cooloff_s or None,

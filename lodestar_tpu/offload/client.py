@@ -232,11 +232,13 @@ class BlsOffloadClient(IBlsVerifier):
         breaker_max_reset_s: float = DEFAULT_MAX_RESET_TIMEOUT_S,
         class_deadlines: dict[PriorityClass, float] | None = None,
         hedge_classes: frozenset[PriorityClass] | None = None,
+        hedge_delay_ms: float | None = None,
         metrics=None,
         transport_wrapper=None,
         auditor=None,
         quarantine_cooloff_s: float | None = DEFAULT_QUARANTINE_COOLOFF_S,
         tenant: str | None = None,
+        breaker_clock=None,
     ) -> None:
         targets = [target] if isinstance(target, str) else list(target)
         if not targets:
@@ -271,6 +273,13 @@ class BlsOffloadClient(IBlsVerifier):
             auditor.bind(self.quarantine_endpoint)
         self._class_deadlines = dict(class_deadlines or CLASS_DEADLINE_S)
         self._hedge_classes = HEDGE_CLASSES if hedge_classes is None else hedge_classes
+        # true-hedge trigger (--offload-hedge-delay-ms): with a delay
+        # set, a hedge-class RPC still pending past it fires a CONCURRENT
+        # second attempt and the first answer wins. None (the default)
+        # keeps the sequential retry-after-failure behavior.
+        if hedge_delay_ms is not None and hedge_delay_ms < 0:
+            raise ValueError(f"hedge_delay_ms must be >= 0, got {hedge_delay_ms}")
+        self._hedge_delay_s = None if hedge_delay_ms is None else hedge_delay_ms / 1000.0
         self._lock = threading.Lock()
         self._outstanding = 0  # guarded by: _lock
         self._closed = False  # guarded by: close-only (one-way flag; stale readers make one last doomed RPC)
@@ -283,6 +292,9 @@ class BlsOffloadClient(IBlsVerifier):
                     failure_threshold=breaker_threshold,
                     reset_timeout_s=breaker_reset_s,
                     max_reset_timeout_s=breaker_max_reset_s,
+                    # injectable for the deterministic fleet harness
+                    # (SimClock); None keeps the real monotonic clock
+                    clock=breaker_clock if breaker_clock is not None else time.monotonic,
                 ),
             )
             # the closure must not take self._lock: breaker transitions
@@ -645,6 +657,16 @@ class BlsOffloadClient(IBlsVerifier):
             usable = sum(
                 1 for ep in self._endpoints if ep.healthy and not ep.breaker.is_open
             )
+        if (
+            self._hedge_delay_s is not None
+            and priority in self._hedge_classes
+            and usable > 1
+        ):
+            # true hedging: concurrent second attempt after the delay,
+            # first answer wins, full budget per attempt (no splitting)
+            return await self._verify_hedged(
+                frame, frame_tenant, n_sets, priority, deadline, trace_hdr, trace_parent
+            )
         max_attempts = 2 if priority in self._hedge_classes and usable > 1 else 1
         tried: tuple[_Endpoint, ...] = ()
         last_err: OffloadError | None = None
@@ -722,6 +744,168 @@ class BlsOffloadClient(IBlsVerifier):
         if last_err is not None:
             raise last_err
         raise OffloadError("no offload endpoint admits work (all breakers open)")
+
+    def _launch_attempt(
+        self,
+        loop,
+        ep: "_Endpoint",
+        token: "int | None",
+        frame: bytes,
+        frame_tenant: "bytes | None",
+        n_sets: int,
+        priority: PriorityClass,
+        attempt_deadline: float,
+        trace_hdr,
+        trace_parent,
+    ):
+        """Launch one verify attempt on the executor WITHOUT awaiting it
+        (the hedged path races these). Outstanding counters settle in a
+        done-callback so a discarded loser still balances the books, and
+        its exception is retrieved there — breaker/audit accounting for
+        losers already happened inside `_call_endpoint` on the executor
+        thread, so discarding the future drops only the verdict."""
+        if self._metrics is not None:
+            self._metrics.routed.labels(ep.target).inc()
+        use_frame = (
+            frame_tenant
+            # lint: allow(lock-discipline) — one-way sticky capability bit: a stale False sends one more legacy frame, which every server parses
+            if frame_tenant is not None and ep.tenant_capable
+            else frame
+        )
+        with self._lock:
+            self._outstanding += 1
+            ep.outstanding += 1
+        fut = loop.run_in_executor(
+            None,
+            self._call_endpoint,
+            ep, token, use_frame, n_sets, priority, attempt_deadline, trace_hdr, trace_parent,
+        )
+
+        def _settle(f, ep=ep):
+            with self._lock:
+                self._outstanding -= 1
+                ep.outstanding -= 1
+            if not f.cancelled():
+                f.exception()  # retrieved so a discarded loser never warns
+
+        fut.add_done_callback(_settle)
+        return fut
+
+    async def _verify_hedged(
+        self,
+        frame: bytes,
+        frame_tenant: "bytes | None",
+        n_sets: int,
+        priority: PriorityClass,
+        deadline: float,
+        trace_hdr,
+        trace_parent,
+    ) -> bool:
+        """True hedged request: the primary attempt gets the FULL class
+        budget; if it is still in flight past the hedge delay, a second
+        concurrent attempt fires on a different endpoint and the first
+        verdict wins. The loser is discarded, not interrupted — executor
+        RPCs cannot be cancelled mid-flight, so its breaker and audit
+        accounting (inside `_call_endpoint`) stand while its verdict is
+        dropped. At most ONE delay-triggered hedge fires per job; a
+        server-side shed spawns a replacement without consuming the
+        error budget (the endpoint explicitly redirected us), a
+        transport/server error consumes one of two error attempts —
+        the same failover bound as the sequential path."""
+        loop = asyncio.get_event_loop()
+        t_start = time.monotonic()
+        m = self._metrics
+        tried: tuple[_Endpoint, ...] = ()
+        ep_of: dict = {}
+        pending: set = set()
+        hedge_fired = False
+        hedge_fut = None  # the delay-triggered attempt, if one fired
+        error_attempts = 0
+        last_err: OffloadError | None = None
+
+        def _launch():
+            nonlocal tried
+            picked = self._pick_endpoint(priority, exclude=tried)
+            if picked is None:
+                return None
+            ep, token = picked
+            tried = tried + (ep,)
+            remaining = deadline - (time.monotonic() - t_start)
+            fut = self._launch_attempt(
+                loop, ep, token, frame, frame_tenant, n_sets,
+                priority, remaining, trace_hdr, trace_parent,
+            )
+            ep_of[fut] = ep
+            pending.add(fut)
+            return fut
+
+        primary = _launch()
+        if primary is None:
+            raise OffloadError("no offload endpoint admits work (all breakers open)")
+        while pending:
+            remaining = deadline - (time.monotonic() - t_start)
+            if remaining <= 0:
+                break
+            timeout = (
+                remaining
+                if hedge_fired
+                else min(remaining, self._hedge_delay_s)
+            )
+            done, still = await asyncio.wait(
+                pending, timeout=timeout, return_when=asyncio.FIRST_COMPLETED
+            )
+            pending.clear()
+            pending.update(still)
+            if not done:
+                # hedge delay elapsed with the primary still in flight:
+                # fire AT MOST one delay-triggered hedge (further waits
+                # run out the remaining budget on whatever is in flight)
+                if not hedge_fired:
+                    hedge_fired = True
+                    prev = tried[0]
+                    fut = _launch()
+                    if fut is not None:
+                        hedge_fut = fut
+                        self._note_hedge(prev, ep_of[fut], priority, trace_parent)
+                continue
+            winners = [f for f in done if f.exception() is None]
+            if winners:
+                # both may land in the same wake-up: prefer the primary
+                # so hedge_wins counts only races the hedge actually won
+                # (an error-failover replacement winning is a failover,
+                # already counted as one, not a hedge win)
+                win = primary if primary in winners else winners[0]
+                if win is hedge_fut and m is not None:
+                    m.hedge_wins.labels(priority.label).inc()
+                return win.result()
+            for fut in done:
+                err = fut.exception()
+                ep = ep_of[fut]
+                if isinstance(err, OffloadShed):
+                    # admission refusal: fail over without charging the
+                    # endpoint or the error budget — bounded by the
+                    # untried-endpoint pool via `tried`
+                    last_err = err
+                    self.log.info(
+                        "offload shed failover",
+                        {"from": ep.target, "class": priority.label, "reason": str(err)[:80]},
+                    )
+                    if m is not None:
+                        m.shed.labels("server_shed").inc()
+                    if not pending:
+                        _launch()
+                elif isinstance(err, OffloadError):
+                    last_err = err
+                    error_attempts += 1
+                    if m is not None:
+                        m.failovers.labels(ep.target).inc()
+                    if not pending and error_attempts < 2:
+                        _launch()
+                else:
+                    raise err
+        if last_err is not None:
+            raise last_err
+        raise OffloadError("offload verify budget exhausted before any verdict")
 
     def _note_hedge(
         self, first: _Endpoint, second: _Endpoint, priority: PriorityClass, trace_parent

@@ -64,6 +64,7 @@ __all__ = [
     "CircuitBreaker",
     "CLASS_DEADLINE_S",
     "HEDGE_CLASSES",
+    "DEFAULT_HEDGE_DELAY_MS",
     "DEFAULT_FAILURE_THRESHOLD",
     "DEFAULT_RESET_TIMEOUT_S",
     "DEFAULT_MAX_RESET_TIMEOUT_S",
@@ -114,6 +115,16 @@ CLASS_DEADLINE_S: dict[PriorityClass, float] = {
 #: (the deadline budget covers two attempts; bulk work just fails over
 #: to the degradation chain / next submission instead)
 HEDGE_CLASSES = frozenset({PriorityClass.GOSSIP_BLOCK, PriorityClass.GOSSIP_ATTESTATION})
+
+#: true-hedge trigger delay (`--offload-hedge-delay-ms`): how long the
+#: first hedge-class RPC may stay pending before the client fires a
+#: CONCURRENT second attempt on a sibling endpoint (client.py's hedged
+#: path; None/unset keeps the sequential retry-after-failure behavior).
+#: Tuned against the chaos harness's latency_ramp scenario — sits above
+#: the healthy-path p95 so steady state fires ~no hedges, far enough
+#: under the gossip-block deadline that the hedge still has budget to
+#: win. Provenance: TUNING.md (exp-latency_ramp-hedge_delay_ms).
+DEFAULT_HEDGE_DELAY_MS = 30.0
 
 
 def deadline_for(

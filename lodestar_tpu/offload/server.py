@@ -31,6 +31,7 @@ DCN (SURVEY §2d).
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 
 import grpc
@@ -53,7 +54,14 @@ from . import (
 )
 from .tenancy import TenantScheduler
 
-__all__ = ["BlsOffloadServer", "SERVICE_NAME", "VERIFY_METHOD", "STATUS_METHOD"]
+__all__ = [
+    "BlsOffloadServer",
+    "SERVICE_NAME",
+    "VERIFY_METHOD",
+    "STATUS_METHOD",
+    "LocalStub",
+    "local_transports",
+]
 
 SERVICE_NAME = "lodestar.BlsOffload"
 VERIFY_METHOD = f"/{SERVICE_NAME}/VerifySignatureSets"
@@ -62,6 +70,92 @@ STATUS_METHOD = f"/{SERVICE_NAME}/Status"
 
 def _identity(b: bytes) -> bytes:
     return b
+
+
+# -- in-process transport seam --------------------------------------------------
+#
+# The fleet chaos harness (testing/fleet.py) runs N clients against M
+# servers IN ONE PROCESS: dialing real sockets there would add kernel
+# scheduling noise to a simulation whose whole contract is determinism.
+# These shims dispatch a client's stub calls straight into the server's
+# handlers — the exact `_verify`/`_status` code paths the wire exercises
+# (tenancy, admission, trailing-metadata trace spans, digest-checked
+# verdicts), minus the socket. They plug into `BlsOffloadClient`'s
+# `transport_wrapper` hook, the same seam the fault injector uses, so a
+# `FaultInjector` chains IN FRONT of the local dispatch and every edge
+# still sees its faults.
+
+
+class _LocalContext:
+    """Duck-typed grpc.ServicerContext for in-process dispatch: carries
+    invocation metadata in, a deadline for `time_remaining()`, and the
+    trailing metadata the handler sets back out."""
+
+    def __init__(self, metadata=None, timeout_s: float | None = None, clock=None):
+        self._metadata = tuple(metadata or ())
+        self._clock = clock if clock is not None else time.monotonic
+        self._deadline = self._clock() + timeout_s if timeout_s is not None else None
+        self.trailing = ()
+
+    def invocation_metadata(self):
+        return self._metadata
+
+    def time_remaining(self):
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - self._clock())
+
+    def set_trailing_metadata(self, md) -> None:
+        self.trailing = tuple(md or ())
+
+
+class _LocalCall:
+    """grpc.Call twin for `.with_call`: hands back the trailing metadata
+    the handler set on its context."""
+
+    def __init__(self, ctx: _LocalContext):
+        self._ctx = ctx
+
+    def trailing_metadata(self):
+        return self._ctx.trailing
+
+
+class LocalStub:
+    """In-process unary-unary callable: the shapes the client uses
+    (`__call__` and `.with_call`) dispatched straight into a server
+    handler on the calling thread."""
+
+    def __init__(self, handler, clock=None):
+        self._handler = handler
+        self._clock = clock
+
+    def __call__(self, request: bytes, timeout=None, metadata=None) -> bytes:
+        resp, _call = self.with_call(request, timeout=timeout, metadata=metadata)
+        return resp
+
+    def with_call(self, request: bytes, timeout=None, metadata=None):
+        ctx = _LocalContext(metadata, timeout, self._clock)
+        return self._handler(request, ctx), _LocalCall(ctx)
+
+
+def local_transports(servers: dict, *, wrap=None, clock=None):
+    """Build a `BlsOffloadClient(transport_wrapper=...)` that serves
+    `servers[target]` in-process instead of dialing. `wrap(target,
+    method, fn)` — e.g. `FaultInjector.wrap_transport` — chains a fault
+    seam in front of the local dispatch; unknown targets keep the dialed
+    stub (mixed local/remote deployments still work). `clock` feeds the
+    local contexts' `time_remaining()` (a `SimClock.monotonic` under the
+    fleet harness)."""
+
+    def wrapper(target: str, method: str, fn):
+        server = servers.get(target)
+        if server is not None:
+            fn = LocalStub(
+                server._verify if method == "verify" else server._status, clock=clock
+            )
+        return fn if wrap is None else wrap(target, method, fn)
+
+    return wrapper
 
 
 class _Replied(Exception):

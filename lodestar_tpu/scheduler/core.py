@@ -207,3 +207,14 @@ class PriorityWorkQueue:
 
     def depths(self) -> dict[str, int]:
         return {c.label: len(self._queues[c]) for c in PriorityClass}
+
+    def stats(self) -> dict:
+        """One-shot scheduler snapshot (chaos-harness ledger / debug):
+        per-class depths plus the fairness counters that summarize how
+        contended the queue has been so far."""
+        return {
+            "depths": self.depths(),
+            "size": self._size,
+            "starvation_promotions": self.starvation_promotions,
+            "vtime": self._vtime,
+        }

@@ -183,6 +183,9 @@ class _SloAccountant:
         self.model: SlotDeadlineModel | None = None
         self.metrics = None  # SloMetrics | None
         self.slack_floor_s = 0.0
+        # injectable monotonic source so the chaos harness can stamp
+        # job legs on virtual time (SimClock.monotonic_ns)
+        self.monotonic_ns: Callable[[], int] = time.monotonic_ns
         self._lock = threading.Lock()
         # (class, leg) -> ring of leg durations (seconds)
         self._legs: dict[tuple[PriorityClass, str], deque] = {}
@@ -336,11 +339,14 @@ def configure_slo(
     metrics=None,
     slack_floor_ms: float = 0.0,
     time_fn: Callable[[], float] = time.time,
+    monotonic_ns_fn: Callable[[], int] = time.monotonic_ns,
 ) -> None:
     """(Re)configure the process-global accountant. `metrics` is a
     `SloMetrics` dataclass (or None to keep slack accounting local).
     Disabled or genesis-less: every job hook degrades to a single None
-    check."""
+    check. `monotonic_ns_fn` pairs with `time_fn` when the caller runs
+    on virtual time (chaos harness): wall-clock deadlines and job-leg
+    stamps must advance together or leg durations go negative."""
     if enabled and genesis_time is not None:
         _ACCT.model = SlotDeadlineModel(
             genesis_time=genesis_time,
@@ -352,6 +358,7 @@ def configure_slo(
         _ACCT.model = None
     _ACCT.metrics = metrics
     _ACCT.slack_floor_s = slack_floor_ms / 1000.0
+    _ACCT.monotonic_ns = monotonic_ns_fn
 
 
 def reset_slo() -> None:
@@ -359,6 +366,7 @@ def reset_slo() -> None:
     _ACCT.model = None
     _ACCT.metrics = None
     _ACCT.slack_floor_s = 0.0
+    _ACCT.monotonic_ns = time.monotonic_ns
     with _ACCT._lock:
         _ACCT._legs.clear()
         _ACCT._e2e.clear()
@@ -386,7 +394,7 @@ def job_begin(priority: PriorityClass, slot: int | None = None) -> JobSlo | None
         return None
     cls = PriorityClass(priority)
     deadline = model.deadline_for(cls, slot)
-    js = JobSlo(cls, slot, deadline, time.monotonic_ns())
+    js = JobSlo(cls, slot, deadline, _ACCT.monotonic_ns())
     _ACCT.observe_slack(cls, "enqueue", deadline - model.now())
     return js
 
@@ -394,14 +402,14 @@ def job_begin(priority: PriorityClass, slot: int | None = None) -> JobSlo | None
 def job_flushed(js: JobSlo | None) -> None:
     """Batchable job left the accumulation buffer for the queue."""
     if js is not None:
-        js.t_flush_ns = time.monotonic_ns()
+        js.t_flush_ns = _ACCT.monotonic_ns()
 
 
 def job_dequeued(js: JobSlo | None, waited_ns: int = 0) -> None:
     """Scheduler handed the job to a worker: dispatch-stage slack."""
     if js is None:
         return
-    js.t_dequeue_ns = time.monotonic_ns()
+    js.t_dequeue_ns = _ACCT.monotonic_ns()
     js.queue_wait_ns = waited_ns
     model = _ACCT.model
     if model is not None:
@@ -411,7 +419,7 @@ def job_dequeued(js: JobSlo | None, waited_ns: int = 0) -> None:
 def job_launch(js: JobSlo | None) -> None:
     """Staging done, device launch starting."""
     if js is not None:
-        js.t_launch_ns = time.monotonic_ns()
+        js.t_launch_ns = _ACCT.monotonic_ns()
 
 
 def job_verdict(js: JobSlo | None, ok: bool) -> None:
@@ -423,7 +431,7 @@ def job_verdict(js: JobSlo | None, ok: bool) -> None:
         return
     model = _ACCT.model
     slack = (js.deadline_s - model.now()) if model is not None else 0.0
-    _ACCT.record_verdict(js, ok, time.monotonic_ns(), slack)
+    _ACCT.record_verdict(js, ok, _ACCT.monotonic_ns(), slack)
 
 
 # -- span/dump helpers ---------------------------------------------------------

@@ -3,8 +3,16 @@
 `faults` is the fault-injection seam the chaos suite drives through the
 offload client/server and the verify backend — seeded, scheduled fault
 delivery so every chaos run is reproducible from its seed.
+
+`clock` is the virtual-time seam (`SimClock`) and `fleet` the seeded
+multi-node chaos harness built on both: N in-process beacon verification
+stacks against M offload hosts, a mainnet-shaped synthetic workload, and
+a replayable verdict ledger. Imported lazily where possible — `fleet`
+pulls the whole offload stack, which plain fault-injection tests don't
+need.
 """
 
+from .clock import SimClock  # noqa: F401
 from .faults import FaultInjector, FaultKind, FaultRule  # noqa: F401
 
-__all__ = ["FaultInjector", "FaultKind", "FaultRule"]
+__all__ = ["FaultInjector", "FaultKind", "FaultRule", "SimClock"]

@@ -23,6 +23,20 @@ Determinism: faults fire by per-(target, method) call index against
 concurrency the interleaving of coin draws can vary — schedule-window
 rules stay exact regardless).
 
+Replayable traces: `schedule()` exports the faults that actually fired
+as exact (target, method, call_index) records, and
+`FaultInjector.from_trace()` rebuilds an injector whose rules pin every
+one of those records to its exact call index — a failed probabilistic
+chaos run's fault schedule becomes a deterministic pinned regression
+test, independent of RNG draw interleaving. (Payload-level corruption
+bytes for CORRUPT_VERDICT still come from the replay injector's own
+seeded RNG; the schedule — which fault, on which edge, at which call —
+replays exactly.)
+
+Virtual time: `sleep_fn` (default `time.sleep`) is the seam the fleet
+harness points at `SimClock.sleep`, so injected latency advances the
+simulation's virtual clock instead of stalling the test for real.
+
 Verdict-flip scope: `FLIP_VERDICT` flips the verdict byte of a
 well-formed reply IN FLIGHT — the digest check (`decode_verdict`)
 catches it and the client fails closed. `LIE_VERDICT` is the byzantine
@@ -120,12 +134,19 @@ class _CallRecord:
     method: str
     call_index: int
     fault: FaultKind | None
+    delay_s: float = 0.0
 
 
 class FaultInjector:
     """Seeded, scheduled fault delivery through the offload seams."""
 
-    def __init__(self, rules: tuple[FaultRule, ...] | list[FaultRule] = (), seed: int = 0):
+    def __init__(
+        self,
+        rules: tuple[FaultRule, ...] | list[FaultRule] = (),
+        seed: int = 0,
+        *,
+        sleep_fn=None,
+    ):
         self.rules = list(rules)
         self.seed = seed
         self._rng = random.Random(seed)
@@ -134,6 +155,29 @@ class FaultInjector:
         self._partitioned: set[str] = set()
         self.calls: list[_CallRecord] = []
         self.injected: dict[FaultKind, int] = {k: 0 for k in FaultKind}
+        # latency/deadline sleeps go through this seam so a virtual
+        # clock (testing/clock.SimClock) can absorb them deterministically
+        self._sleep = time.sleep if sleep_fn is None else sleep_fn
+
+    @classmethod
+    def from_trace(cls, trace: dict, *, sleep_fn=None) -> "FaultInjector":
+        """Rebuild an injector from `export_trace()` output: every
+        recorded fault becomes an exact-window rule (first_call ==
+        last_call == its call index, pinned to its edge), so the replay
+        fires the identical fault schedule with NO probabilistic draws —
+        the pinned-regression constructor for a failed chaos run."""
+        rules = [
+            FaultRule(
+                kind=FaultKind(ev["kind"]),
+                first_call=int(ev["call_index"]),
+                last_call=int(ev["call_index"]),
+                delay_s=float(ev.get("delay_s", 0.0)),
+                targets=frozenset({ev["target"]}),
+                methods=frozenset({ev["method"]}),
+            )
+            for ev in trace.get("schedule", ())
+        ]
+        return cls(rules, seed=int(trace.get("seed", 0)), sleep_fn=sleep_fn)
 
     # -- runtime partition control --------------------------------------------
 
@@ -178,11 +222,39 @@ class FaultInjector:
                 if rule.matches(target, method, idx) and (
                     rule.probability >= 1.0 or self._rng.random() < rule.probability
                 ):
-                    self.calls.append(_CallRecord(target, method, idx, rule.kind))
+                    self.calls.append(
+                        _CallRecord(target, method, idx, rule.kind, rule.delay_s)
+                    )
                     self.injected[rule.kind] += 1
                     return rule.kind, rule, idx
             self.calls.append(_CallRecord(target, method, idx, None))
             return None, None, idx
+
+    # -- trace export / replay -------------------------------------------------
+
+    def schedule(self) -> list[dict]:
+        """The faults that actually FIRED, in firing order, as exact
+        (target, method, call_index) records — the SCHEDULE artifact a
+        chaos ledger embeds and `from_trace()` replays. Pure data
+        (JSON-able), stable field order, no RNG state."""
+        with self._lock:
+            return [
+                {
+                    "target": c.target,
+                    "method": c.method,
+                    "call_index": c.call_index,
+                    "kind": c.fault.value,
+                    "delay_s": c.delay_s,
+                }
+                for c in self.calls
+                if c.fault is not None
+            ]
+
+    def export_trace(self) -> dict:
+        """Self-contained replay artifact: the seed (for payload-level
+        corruption draws) plus the exact fault schedule. Feed the dict —
+        or its JSON round-trip — to `FaultInjector.from_trace()`."""
+        return {"seed": self.seed, "schedule": self.schedule()}
 
     def _corrupt(self, data: bytes) -> bytes:
         """Seeded corruption: flip one bit, truncate, or extend."""
@@ -225,18 +297,19 @@ class FaultInjector:
             )
         if kind is FaultKind.DEADLINE:
             # simulated blow-through: the caller sees DEADLINE_EXCEEDED
-            # after rule.delay_s of real wall time (kept small in tests)
+            # after rule.delay_s of wall time (virtual when a SimClock
+            # owns the sleep seam, real — and kept small — in tests)
             if rule is not None and rule.delay_s:
-                time.sleep(rule.delay_s)
+                self._sleep(rule.delay_s)
             raise InjectedRpcError(grpc.StatusCode.DEADLINE_EXCEEDED, "injected deadline")
         if kind is FaultKind.LATENCY:
             delay = rule.delay_s if rule is not None else 0.0
             if timeout is not None and delay >= timeout:
-                time.sleep(timeout)
+                self._sleep(timeout)
                 raise InjectedRpcError(
                     grpc.StatusCode.DEADLINE_EXCEEDED, "injected latency past deadline"
                 )
-            time.sleep(delay)
+            self._sleep(delay)
             return None, None
         if kind is FaultKind.ERROR_FRAME:
             return encode_verdict(None, error="injected server error"), None
@@ -268,7 +341,7 @@ class FaultInjector:
         def wrapped(sets):
             kind, rule, _idx = self._next_fault(target, "backend")
             if kind in (FaultKind.LATENCY, FaultKind.DEADLINE):
-                time.sleep(rule.delay_s if rule is not None else 0.0)
+                self._sleep(rule.delay_s if rule is not None else 0.0)
                 if kind is FaultKind.DEADLINE:
                     raise TimeoutError("injected backend deadline blow-through")
             elif kind is not None:

@@ -1,0 +1,7 @@
+REAL_CONSTANT = 5
+OTHER_NAME = 7  # RENAMED_CONSTANT used to live here
+
+
+def not_it():
+    RENAMED_CONSTANT = 7  # function-local: not a module-level binding
+    return RENAMED_CONSTANT

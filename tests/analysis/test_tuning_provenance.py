@@ -1,0 +1,56 @@
+"""tuning-provenance rule: every constant named in TUNING.md's
+provenance table must still exist as a module-level assignment in the
+file the table points at — renamed/moved constants and vanished files
+are findings, clean ledgers (and trees without one) stay quiet."""
+
+from __future__ import annotations
+
+import pathlib
+
+from tools.analysis import analyze
+from tools.analysis.rules import RULES_BY_NAME
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def findings_for(root) -> list:
+    return analyze(
+        [],
+        rules=[RULES_BY_NAME["tuning-provenance"]],
+        repo_root=root,
+        pragma_hygiene=False,
+    )
+
+
+def test_flags_stale_constant_and_missing_file():
+    msgs = [f.message for f in findings_for(FIXTURES / "tuning_provenance_bad")]
+    joined = " | ".join(msgs)
+    # renamed constant: file exists, module-level binding gone (the
+    # function-local assignment must not count)
+    assert "'RENAMED_CONSTANT'" in joined and "no module-level assignment" in joined
+    # vanished file
+    assert "'ANY_CONSTANT'" in joined and "missing file 'gone.py'" in joined
+    # the intact row stays quiet
+    assert "'REAL_CONSTANT'" not in joined
+    assert len(msgs) == 2, joined
+
+
+def test_findings_anchor_to_tuning_md_lines():
+    findings = findings_for(FIXTURES / "tuning_provenance_bad")
+    for f in findings:
+        assert f.path.endswith("TUNING.md")
+        assert f.line > 0
+
+
+def test_clean_ledger_and_annotated_assignments_pass():
+    assert findings_for(FIXTURES / "tuning_provenance_ok") == []
+
+
+def test_tree_without_ledger_has_nothing_to_check(tmp_path):
+    assert findings_for(tmp_path) == []
+
+
+def test_real_tree_is_clean():
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    findings = findings_for(repo)
+    assert findings == [], [f.format() for f in findings]

@@ -1,0 +1,211 @@
+"""True hedged requests (--offload-hedge-delay-ms): a concurrent
+second RPC fires while the first is still pending past the delay, the
+first verdict wins, and the loser is discarded — raced against real
+wall-clock latency (virtual time cannot exercise a wall-clock hedge
+timer; the fleet harness's hedge_race scenario drives this same path
+end to end)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls.interface import VerifySignatureOpts
+from lodestar_tpu.crypto.bls.api import SignatureSet
+from lodestar_tpu.offload.client import BlsOffloadClient
+from lodestar_tpu.offload.server import BlsOffloadServer
+from lodestar_tpu.scheduler import PriorityClass
+from lodestar_tpu.testing import FaultInjector, FaultKind, FaultRule
+from lodestar_tpu.testing.fleet import MetricsStub
+
+BLOCK = VerifySignatureOpts(priority=PriorityClass.GOSSIP_BLOCK)
+
+
+def _sets(n: int = 2) -> list[SignatureSet]:
+    return [
+        SignatureSet(
+            pubkey=bytes([i + 1]) * 48,
+            message=bytes([i]) * 32,
+            signature=bytes([i]) * 96,
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture()
+def two_hosts():
+    servers = [BlsOffloadServer(lambda s: True, port=0) for _ in range(2)]
+    for s in servers:
+        s.start()
+    targets = [f"127.0.0.1:{s.port}" for s in servers]
+    try:
+        yield targets
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def _client(targets, injector=None, hedge_delay_ms=40.0, **kw):
+    metrics = MetricsStub()
+    kw.setdefault("timeout_s", 5.0)
+    client = BlsOffloadClient(
+        targets,
+        probe_interval_s=3600.0,
+        hedge_delay_ms=hedge_delay_ms,
+        metrics=metrics,
+        transport_wrapper=injector.wrap_transport if injector else None,
+        **kw,
+    )
+    return client, metrics
+
+
+async def _close(client):
+    await client.close()
+
+
+def test_hedge_fires_past_delay_and_wins(two_hosts):
+    """Primary held 400ms, hedge delay 40ms: the hedge must fire, win
+    on the fast host, and be counted as a hedge (not a failover)."""
+    primary = two_hosts[0]
+    inj = FaultInjector(
+        [FaultRule(FaultKind.LATENCY, delay_s=0.4, targets=frozenset({primary}),
+                   methods=frozenset({"verify"}))]
+    )
+    client, metrics = _client(two_hosts, inj)
+
+    async def go():
+        verdict = await client.verify_signature_sets(_sets(), BLOCK)
+        assert verdict is True
+        await _close(client)
+
+    asyncio.run(go())
+    assert metrics.total("hedges") == 1
+    assert metrics.total("hedge_wins") == 1
+    assert metrics.total("failovers") == 0
+    # both endpoints were actually dialed: the race really happened
+    assert inj.calls_to(primary, "verify") == 1
+    assert inj.calls_to(two_hosts[1], "verify") == 1
+
+
+def test_no_hedge_when_primary_answers_fast(two_hosts):
+    client, metrics = _client(two_hosts, hedge_delay_ms=200.0)
+
+    async def go():
+        for _ in range(3):
+            assert await client.verify_signature_sets(_sets(), BLOCK) is True
+        await _close(client)
+
+    asyncio.run(go())
+    assert metrics.total("hedges") == 0
+    assert metrics.total("hedge_wins") == 0
+
+
+def test_loser_verdict_is_discarded_and_counters_settle(two_hosts):
+    """The slow primary's verdict arrives AFTER the hedge already won:
+    exactly one verdict is returned, and outstanding counters drain to
+    zero once the loser lands (no stranded slots, no double-count)."""
+    primary = two_hosts[0]
+    inj = FaultInjector(
+        [FaultRule(FaultKind.LATENCY, delay_s=0.3, targets=frozenset({primary}),
+                   methods=frozenset({"verify"}))]
+    )
+    client, metrics = _client(two_hosts, inj)
+
+    async def go():
+        verdict = await client.verify_signature_sets(_sets(), BLOCK)
+        assert verdict is True
+        # wait out the loser; its late verdict must only decrement
+        # bookkeeping, never surface a second result
+        await asyncio.sleep(0.5)
+        assert client._outstanding == 0
+        for ep in client._endpoints:
+            assert ep.outstanding == 0
+        await _close(client)
+
+    asyncio.run(go())
+    assert metrics.total("hedges") == 1
+
+
+def test_primary_error_is_failover_not_hedge(two_hosts):
+    """A failed primary attempt (UNAVAILABLE) retries sequentially on
+    the second endpoint: counted as a failover, with no hedge fired —
+    the counters must keep the two behaviors distinguishable."""
+    primary = two_hosts[0]
+    inj = FaultInjector(
+        [FaultRule(FaultKind.UNAVAILABLE, first_call=0, last_call=0,
+                   targets=frozenset({primary}), methods=frozenset({"verify"}))]
+    )
+    client, metrics = _client(two_hosts, inj)
+
+    async def go():
+        assert await client.verify_signature_sets(_sets(), BLOCK) is True
+        await _close(client)
+
+    asyncio.run(go())
+    assert metrics.total("failovers") == 1
+    assert metrics.total("hedges") == 0
+    assert metrics.total("hedge_wins") == 0
+
+
+def test_bulk_class_never_hedges(two_hosts):
+    primary = two_hosts[0]
+    inj = FaultInjector(
+        [FaultRule(FaultKind.LATENCY, delay_s=0.2, targets=frozenset({primary}),
+                   methods=frozenset({"verify"}))]
+    )
+    client, metrics = _client(two_hosts, inj)
+
+    async def go():
+        verdict = await client.verify_signature_sets(
+            _sets(), VerifySignatureOpts(priority=PriorityClass.RANGE_SYNC)
+        )
+        assert verdict is True
+        await _close(client)
+
+    asyncio.run(go())
+    assert metrics.total("hedges") == 0
+    assert inj.calls_to(two_hosts[1], "verify") == 0
+
+
+def test_single_endpoint_cannot_hedge(two_hosts):
+    """usable == 1: the delay is configured but there is nowhere to
+    hedge to — the call degrades to the plain single-attempt path."""
+    primary = two_hosts[0]
+    client, metrics = _client([primary])
+
+    async def go():
+        assert await client.verify_signature_sets(_sets(), BLOCK) is True
+        await _close(client)
+
+    asyncio.run(go())
+    assert metrics.total("hedges") == 0
+
+
+def test_sequential_legacy_path_unchanged_without_delay(two_hosts):
+    """hedge_delay_ms=None keeps the pre-existing sequential
+    split-budget retry exactly: a primary latency spike past the first
+    attempt's share produces a failover (counted as hedge+failover by
+    the legacy path), never a concurrent race."""
+    primary = two_hosts[0]
+    inj = FaultInjector(
+        [FaultRule(FaultKind.LATENCY, delay_s=6.0, targets=frozenset({primary}),
+                   methods=frozenset({"verify"}))]
+    )
+    client, metrics = _client(two_hosts, inj, hedge_delay_ms=None, timeout_s=1.0)
+
+    async def go():
+        assert await client.verify_signature_sets(_sets(), BLOCK) is True
+        await _close(client)
+
+    asyncio.run(go())
+    # sequential: the second attempt only starts after the first FAILS
+    # (failover counted), unlike the concurrent race where the primary
+    # is still in flight and no failover fires
+    assert inj.calls_to(two_hosts[1], "verify") == 1
+    assert metrics.total("failovers") == 1
+
+
+def test_negative_hedge_delay_rejected(two_hosts):
+    with pytest.raises(ValueError, match="hedge_delay_ms"):
+        BlsOffloadClient(two_hosts, hedge_delay_ms=-1.0, probe_interval_s=3600.0)

@@ -1,0 +1,84 @@
+"""Fault-trace export/replay (the pinned-regression loop): a seeded —
+even probabilistic — chaos run exports its fired fault schedule, and
+`FaultInjector.from_trace()` replays that exact schedule with no
+probabilistic draws, through a JSON round-trip."""
+
+from __future__ import annotations
+
+import json
+
+from lodestar_tpu.testing import FaultInjector, FaultKind, FaultRule
+from lodestar_tpu.testing.fleet import build_scenario, run_fleet
+
+_PROBABILISTIC = [
+    FaultRule(FaultKind.UNAVAILABLE, probability=0.3, methods=frozenset({"verify"})),
+    FaultRule(
+        FaultKind.LATENCY,
+        probability=0.2,
+        delay_s=0.01,
+        methods=frozenset({"verify"}),
+    ),
+]
+
+
+def _drive(inj: FaultInjector, calls: int = 60) -> None:
+    for i in range(calls):
+        inj._next_fault("edge-a" if i % 2 else "edge-b", "verify")
+
+
+def test_schedule_records_fired_faults_only():
+    inj = FaultInjector(_PROBABILISTIC, seed=11)
+    _drive(inj)
+    sched = inj.schedule()
+    assert sched, "probabilistic rules over 60 calls should fire"
+    assert len(sched) < 60, "schedule must hold FIRED faults, not all calls"
+    for ev in sched:
+        assert set(ev) == {"target", "method", "call_index", "kind", "delay_s"}
+        assert ev["kind"] in ("unavailable", "latency")
+
+
+def test_from_trace_replays_identical_schedule():
+    original = FaultInjector(_PROBABILISTIC, seed=11)
+    _drive(original)
+    trace = json.loads(json.dumps(original.export_trace()))  # wire round-trip
+
+    replay = FaultInjector.from_trace(trace)
+    _drive(replay)
+    assert replay.schedule() == original.schedule()
+
+    # the replay is schedule-driven, not seeded: a different seed in the
+    # trace envelope cannot change which faults fire
+    trace2 = dict(trace, seed=999)
+    replay2 = FaultInjector.from_trace(trace2)
+    _drive(replay2)
+    assert replay2.schedule() == original.schedule()
+
+
+def test_replay_pins_faults_to_their_edges():
+    """A fault recorded against edge-a must not fire on edge-b during
+    replay even when edge-b sees the same call indices."""
+    original = FaultInjector(
+        [FaultRule(FaultKind.RESET, first_call=2, last_call=2, targets=frozenset({"edge-a"}))],
+        seed=0,
+    )
+    _drive(original, 10)
+    replay = FaultInjector.from_trace(original.export_trace())
+    _drive(replay, 10)
+    fired = replay.schedule()
+    assert [ (ev["target"], ev["call_index"]) for ev in fired ] == [("edge-a", 2)]
+
+
+def test_fleet_fault_schedule_is_replayable_json():
+    """The fleet result embeds per-edge schedules in the exact shape
+    from_trace() consumes — the failed-chaos-run -> pinned-regression
+    workflow is a file copy, not a transformation."""
+    result = run_fleet(build_scenario("smoke", seed=4))
+    assert result.fault_schedule
+    for edge, trace in result.fault_schedule.items():
+        sched = trace["schedule"]
+        replay = FaultInjector.from_trace(trace)
+        assert len(replay.rules) == len(sched)
+        for rule, ev in zip(replay.rules, sched):
+            assert rule.kind is FaultKind(ev["kind"])
+            assert rule.first_call == rule.last_call == ev["call_index"]
+            assert rule.targets == frozenset({ev["target"]})

@@ -1,0 +1,88 @@
+"""Full chaos scenario matrix (slow arm): every named scenario holds
+the fleet invariants, and each fault class leaves its specific
+fingerprint — quarantines for liars, failovers+hedges under latency,
+traffic shift off a wedged chip, tenant sheds under a flood."""
+
+from __future__ import annotations
+
+import pytest
+
+from lodestar_tpu.testing.fleet import build_scenario, check_invariants, run_fleet
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["partition_storm", "lying_helper", "latency_ramp", "chip_wedge", "tenant_flood"],
+)
+def test_scenario_invariants(name):
+    result = run_fleet(build_scenario(name, seed=0))
+    assert check_invariants(result) == [], name
+    assert result.summary["wrong_verdicts"] == 0
+
+
+def test_partition_storm_survives_on_cpu():
+    result = run_fleet(build_scenario("partition_storm", seed=0))
+    assert check_invariants(result) == []
+    s = result.summary
+    assert s["served_by_layer"]["cpu"] > 0, "blackout slots must fall back to CPU"
+    assert s["served_by_layer"]["offload"] > s["served_by_layer"]["cpu"]
+    assert s["degraded_slot_count"] >= 6
+
+
+def test_lying_helper_is_quarantined_and_contained():
+    result = run_fleet(build_scenario("lying_helper", seed=0))
+    assert check_invariants(result) == []
+    s = result.summary
+    assert s["byzantine_events"] > 0, "audit at rate 1.0 must catch the liar"
+    liars = {target for _, target in s["quarantined"]}
+    assert liars == {"sim-host-0:9"}, s["quarantined"]
+    # containment: zero wrong verdicts even while the serving host lied
+    assert s["wrong_verdicts"] == 0
+
+
+def test_latency_ramp_fails_over_and_hedges():
+    result = run_fleet(build_scenario("latency_ramp", seed=0))
+    assert check_invariants(result) == []
+    s = result.summary
+    # the 1.5s step blows the gossip-block attempt budget: the client
+    # must retry onto the healthy host (sequential hedge = failover)
+    assert s["failovers"] > 0
+    assert s["hedges"] > 0
+    assert s["sli_misses"] == 0
+
+
+def test_chip_wedge_shifts_traffic_and_returns():
+    result = run_fleet(build_scenario("chip_wedge", seed=0))
+    assert check_invariants(result) == []
+    # wedged host advertises can_accept False; probes mark it unhealthy
+    # and routing avoids it without burning failovers
+    served_during_wedge = {
+        ln["layer"] for ln in result.ledger if 2 <= ln["slot"] < 5
+    }
+    assert served_during_wedge == {"offload"}
+    by_target: dict[str, float] = {}
+    for node_metrics in (result.metrics or {}).values():
+        for labels, val in node_metrics.get("routed", {}).items():
+            by_target[labels] = by_target.get(labels, 0.0) + val
+    if by_target:  # routed counter present: host 1 must have taken load
+        assert any("sim-host-1:9" in k for k in by_target)
+
+
+def test_tenant_flood_sheds_but_gossip_lives():
+    result = run_fleet(build_scenario("tenant_flood", seed=0))
+    assert check_invariants(result) == []
+    s = result.summary
+    assert s["sheds"] > 0, "quota must shed the flooding tenant"
+    for ln in result.ledger:
+        if ln["cls"] == "gossip_block":
+            assert ln["verdict"] is True
+
+
+def test_hedge_race_true_hedging_wins():
+    result = run_fleet(build_scenario("hedge_race", seed=0))
+    assert check_invariants(result) == []
+    s = result.summary
+    assert s["hedges"] > 0, "250ms primary latency must trip the 30ms hedge"
+    assert s["hedge_wins"] > 0, "the fast second host must win the race"

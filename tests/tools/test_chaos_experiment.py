@@ -1,0 +1,179 @@
+"""Chaos experiment runner (tools/chaos_experiment.py): scenario runs
+gate on the fleet invariants (exit nonzero on violation), the two
+chaos bench lines come out in the trajectory-parseable JSON shape, the
+sweep picks by the documented lexicographic score, and --write-tuning
+keys TUNING.md rows by constant (replace, not append)."""
+
+from __future__ import annotations
+
+import sys
+
+import pytest
+
+sys.path.insert(0, "tools")
+
+import chaos_experiment as ce  # noqa: E402
+from tools.bench_trajectory import LOWER_IS_BETTER, THRESHOLDS, parse_bench_lines
+
+
+def test_smoke_scenario_exits_zero_and_emits_gated_lines(capsys):
+    rc = ce.main(["--scenario", "smoke", "--seed", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = {l["metric"]: l for l in parse_bench_lines(out)}
+    assert set(lines) == {
+        "chaos_degraded_throughput_retention_pct",
+        "chaos_recovery_slots",
+    }
+    # both lines are actually gated by the trajectory thresholds, with
+    # recovery in the lower-is-better direction
+    for metric in lines:
+        assert metric in THRESHOLDS
+    assert "chaos_recovery_slots" in LOWER_IS_BETTER
+    assert lines["chaos_degraded_throughput_retention_pct"]["value"] > 0
+
+
+def test_invariant_violation_exits_nonzero(monkeypatch, capsys):
+    monkeypatch.setattr(
+        ce, "check_invariants", lambda result: ["WRONG VERDICT: injected"]
+    )
+    rc = ce.main(["--scenario", "smoke"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "INVARIANT VIOLATION" in err
+
+
+def test_parse_value():
+    assert ce._parse_value("none") is None
+    assert ce._parse_value("Null") is None
+    assert ce._parse_value("30") == 30 and isinstance(ce._parse_value("30"), int)
+    assert ce._parse_value("0.5") == 0.5
+    assert ce._parse_value("cpu") == "cpu"
+
+
+def test_sweep_requires_knob_syntax():
+    with pytest.raises(SystemExit):
+        ce.main(["--sweep", "hedge_delay_ms"])  # no '=': argparse error
+
+
+def test_mode_required():
+    with pytest.raises(SystemExit):
+        ce.main([])
+
+
+def test_write_tuning_row_replaces_by_constant(tmp_path):
+    ledger = tmp_path / "TUNING.md"
+    ledger.write_text(
+        "# Tuned\n\n"
+        "| constant | value | defined in | experiment | scenario | seeds | metric |\n"
+        "|---|---|---|---|---|---|---|\n"
+        "| `A_CONST` | 1 | `a.py` | exp-old | s | 0 | m=1 |\n"
+        "| `B_CONST` | 2 | `b.py` | exp-b | s | 0 | m=2 |\n"
+    )
+    ce.write_tuning_row(
+        str(ledger), "A_CONST", 9, "a.py", "exp-new", "smoke", [0, 1], "m=9"
+    )
+    text = ledger.read_text()
+    assert "exp-new" in text and "exp-old" not in text
+    assert text.count("`A_CONST`") == 1  # replaced, not appended
+    assert "| `B_CONST` | 2 |" in text  # untouched
+
+    # unknown constant: appended after the last table row
+    ce.write_tuning_row(
+        str(ledger), "C_CONST", 3, "c.py", "exp-c", "smoke", [0], "m=3"
+    )
+    lines = ledger.read_text().splitlines()
+    assert lines[-1].startswith("| `C_CONST` |")
+
+
+def test_sweep_scores_lexicographically(monkeypatch, capsys, tmp_path):
+    """Candidate 20 loses on sli_misses despite equal retention;
+    candidate 10 wins and lands in TUNING.md with its experiment ID."""
+
+    def fake_run_one(name, seed, **overrides):
+        value = overrides["hedge_delay_ms"]
+        summary = {
+            "scenario": name,
+            "seed": seed,
+            "total_jobs": 10,
+            "wrong_verdicts": 0,
+            "sli_misses": 0 if value == 10 else 3,
+            "throughput_retention_pct": 100.0,
+            "recovery_slots": 0,
+            "mean_latency_ms": 5.0,
+            "hedges": 1,
+            "hedge_wins": 1,
+            "failovers": 0,
+            "sheds": 0,
+            "byzantine_events": 0,
+        }
+
+        class R:
+            pass
+
+        r = R()
+        r.summary = summary
+        return r, []
+
+    ledger = tmp_path / "TUNING.md"
+    ledger.write_text(
+        "| constant | value | defined in | experiment | scenario | seeds | metric |\n"
+        "|---|---|---|---|---|---|---|\n"
+        "| `DEFAULT_HEDGE_DELAY_MS` | 30.0 | `x.py` | exp-old | s | 0 | m |\n"
+    )
+    monkeypatch.setattr(ce, "_run_one", fake_run_one)
+    monkeypatch.setattr(ce, "TUNING_PATH", str(ledger))
+    rc = ce.main(
+        ["--sweep", "hedge_delay_ms=20,10", "--scenario", "smoke", "--write-tuning"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "winner: hedge_delay_ms=10" in out
+    assert "exp-smoke-hedge_delay_ms" in ledger.read_text()
+
+
+def test_write_tuning_unknown_knob_is_an_error(monkeypatch, capsys):
+    def fake_run_one(name, seed, **overrides):
+        class R:
+            summary = {
+                "scenario": name, "seed": seed, "total_jobs": 1,
+                "wrong_verdicts": 0, "sli_misses": 0,
+                "throughput_retention_pct": 100.0, "recovery_slots": 0,
+                "mean_latency_ms": 1.0, "hedges": 0, "hedge_wins": 0,
+                "failovers": 0, "sheds": 0, "byzantine_events": 0,
+            }
+
+        return R(), []
+
+    monkeypatch.setattr(ce, "_run_one", fake_run_one)
+    rc = ce.main(
+        ["--sweep", "validators=1,2", "--scenario", "smoke", "--write-tuning"]
+    )
+    assert rc == 2
+    assert "no constant mapping" in capsys.readouterr().err
+
+
+def test_knob_constants_point_at_real_definitions():
+    """Every sweepable knob's (constant, file) mapping must hold in the
+    real tree — the same contract the tuning-provenance rule enforces
+    for TUNING.md rows."""
+    import ast
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    for knob, (constant, rel) in ce.KNOB_CONSTANTS.items():
+        path = repo / rel
+        assert path.is_file(), (knob, rel)
+        tree = ast.parse(path.read_text())
+        names = {
+            t.id
+            for node in tree.body
+            if isinstance(node, ast.Assign)
+            for t in node.targets
+            if isinstance(t, ast.Name)
+        } | {
+            node.target.id
+            for node in tree.body
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name)
+        }
+        assert constant in names, (knob, constant, rel)

@@ -11,6 +11,7 @@ from .lock_discipline import LockDisciplineRule
 from .monotonic import MonotonicDurationsRule
 from .rest_wiring import RestRouteWiringRule
 from .span_discipline import SpanDisciplineRule
+from .tuning_provenance import TuningProvenanceRule
 from .wiring import MetricsCliWiringRule
 
 ALL_RULES = (
@@ -24,6 +25,7 @@ ALL_RULES = (
     FaultWiringRule(),
     BenchWiringRule(),
     AlertWiringRule(),
+    TuningProvenanceRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -4,9 +4,10 @@ wiring rules (same doctrine: a bench line the gate never checks, or a
 threshold gating a line nobody emits, silently does nothing exactly
 when the chip run depends on it).
 
-Project-scoped over three fixed locations:
+Project-scoped over four fixed locations:
 
-* ``tools/baseline_configs_bench.py`` — every ``_line("name", ...)``
+* ``tools/baseline_configs_bench.py`` and
+  ``tools/chaos_experiment.py`` — every ``_line("name", ...)``
   reporting call. Literal first args are exact line names; f-string
   first args (``f"mesh_sigs_per_sec_{n}dev"``) become match patterns
   with each interpolation treated as a wildcard; anything else (a bare
@@ -48,6 +49,7 @@ from pathlib import Path
 from ..core import Finding, Rule
 
 BENCH_REL = Path("tools") / "baseline_configs_bench.py"
+CHAOS_REL = Path("tools") / "chaos_experiment.py"
 HEADLINE_REL = Path("bench.py")
 TRAJECTORY_REL = Path("tools") / "bench_trajectory.py"
 REPORT_FN = "_line"
@@ -177,27 +179,39 @@ class BenchWiringRule(Rule):
         if bench_tree is None or traj_tree is None:
             return findings
 
-        exact, patterns, dynamic = _reported_names(bench_tree)
-        # carry the SOURCE file per exact name so an ungated headline
-        # from bench.py is reported against bench.py, not misattributed
-        # to baseline_configs_bench.py at an unrelated line
-        exact = [(name, str(bench_path), line) for name, line in exact]
+        # carry the SOURCE file per reported name so an ungated line is
+        # reported against the file that emits it, not misattributed to
+        # baseline_configs_bench.py at an unrelated line
+        exact: list[tuple[str, str, int]] = []
+        patterns: list[tuple[re.Pattern, str, str, int]] = []
+        reporters = [(bench_path, bench_tree)]
+        chaos_path = repo_root / CHAOS_REL
+        if chaos_path.is_file():
+            chaos_tree = _parse(chaos_path)
+            if chaos_tree is not None:
+                reporters.append((chaos_path, chaos_tree))
+        for src_path, tree in reporters:
+            src_exact, src_patterns, dynamic = _reported_names(tree)
+            exact.extend((name, str(src_path), line) for name, line in src_exact)
+            patterns.extend(
+                (pat, text, str(src_path), line)
+                for pat, text, line in src_patterns
+            )
+            for line in dynamic:
+                findings.append(
+                    Finding(
+                        self.name, str(src_path), line,
+                        f"{REPORT_FN}() first argument is not a literal or "
+                        "f-string — the bench line name cannot be statically "
+                        "gated by the trajectory thresholds",
+                    )
+                )
         headline_path = repo_root / HEADLINE_REL
         if headline_path.is_file():
             headline_tree = _parse(headline_path)
             if headline_tree is not None:
                 for name, line in _headline_names(headline_tree):
                     exact.append((name, str(headline_path), line))
-
-        for line in dynamic:
-            findings.append(
-                Finding(
-                    self.name, str(bench_path), line,
-                    f"{REPORT_FN}() first argument is not a literal or "
-                    "f-string — the bench line name cannot be statically "
-                    "gated by the trajectory thresholds",
-                )
-            )
 
         thresholds = _dict_literal_keys(traj_tree, THRESHOLDS_NAME)
         direction = _set_literal_members(traj_tree, DIRECTION_NAME)
@@ -216,14 +230,15 @@ class BenchWiringRule(Rule):
         for key, line in sorted(thresholds.items()):
             if key in exact_names:
                 continue
-            if any(p.match(key) for p, _, _ in patterns):
+            if any(p.match(key) for p, _, _, _ in patterns):
                 continue
             findings.append(
                 Finding(
                     self.name, str(traj_path), line,
                     f"{THRESHOLDS_NAME} entry '{key}' names no bench line "
-                    "reported by baseline_configs_bench.py or bench.py — "
-                    "remove the stale threshold or fix the line name",
+                    "reported by baseline_configs_bench.py, "
+                    "chaos_experiment.py, or bench.py — remove the stale "
+                    "threshold or fix the line name",
                 )
             )
         # bench -> thresholds: every reported line is gated
@@ -241,11 +256,11 @@ class BenchWiringRule(Rule):
                         "ungated",
                     )
                 )
-        for pattern, text, line in patterns:
+        for pattern, text, src_path, line in patterns:
             if not any(pattern.match(key) for key in thresholds):
                 findings.append(
                     Finding(
-                        self.name, str(bench_path), line,
+                        self.name, src_path, line,
                         f"bench line pattern '{text}' matches no "
                         f"{THRESHOLDS_NAME} entry — the lines it emits would "
                         "regress ungated",

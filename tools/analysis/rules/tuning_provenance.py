@@ -1,0 +1,102 @@
+"""tuning-provenance: every constant TUNING.md claims provenance for
+actually exists where the table says it lives.
+
+TUNING.md is the provenance ledger for hand-tuned constants — each row
+names a constant (backticked, column 1) and its defining module
+(backticked path, column 3), written by `tools/chaos_experiment.py
+--write-tuning`. The ledger is only worth trusting if it cannot go
+stale silently: a constant renamed or moved after its experiment row
+was recorded would leave the table pointing at nothing, and the next
+reader re-tuning "the documented value" would be reading fiction.
+
+Project-scoped checks over ``TUNING.md`` rows:
+
+1. the referenced file exists in the tree;
+2. the file contains a module-level assignment (plain or annotated)
+   binding exactly that constant name.
+
+A repo without a TUNING.md has nothing to check — the rule only gates
+trees that carry the ledger.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from ..core import Finding, Rule
+
+TUNING_REL = Path("TUNING.md")
+
+#: | `CONSTANT` | value | `path/to/file.py` | ...
+_ROW_RE = re.compile(
+    r"^\|\s*`(?P<constant>[A-Za-z_][A-Za-z0-9_]*)`\s*\|"
+    r"[^|]*\|\s*`(?P<path>[^`|]+)`\s*\|"
+)
+
+
+def _table_rows(text: str):
+    """(constant, path, line_number) per provenance row."""
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _ROW_RE.match(line.strip())
+        if m:
+            yield m.group("constant"), m.group("path").strip(), i
+
+
+def _module_level_names(tree: ast.Module) -> set:
+    names: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+class TuningProvenanceRule(Rule):
+    name = "tuning-provenance"
+    description = (
+        "every constant in the TUNING.md provenance table exists as a "
+        "module-level assignment in the file the table names"
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        findings: list[Finding] = []
+        tuning_path = repo_root / TUNING_REL
+        if not tuning_path.is_file():
+            return findings  # no ledger, nothing to go stale
+        text = tuning_path.read_text(encoding="utf-8")
+        parsed: dict[Path, set | None] = {}
+        for constant, rel, line in _table_rows(text):
+            target = repo_root / rel
+            if not target.is_file():
+                findings.append(
+                    Finding(
+                        self.name, str(tuning_path), line,
+                        f"provenance row for '{constant}' names missing "
+                        f"file '{rel}'",
+                    )
+                )
+                continue
+            if target not in parsed:
+                try:
+                    parsed[target] = _module_level_names(
+                        ast.parse(target.read_text(encoding="utf-8"))
+                    )
+                except SyntaxError:
+                    parsed[target] = None  # surfaced by the parse rule
+            names = parsed[target]
+            if names is not None and constant not in names:
+                findings.append(
+                    Finding(
+                        self.name, str(tuning_path), line,
+                        f"provenance row names constant '{constant}' but "
+                        f"'{rel}' has no module-level assignment binding it "
+                        "— the ledger went stale (renamed/moved constant?)",
+                    )
+                )
+        return findings

@@ -83,6 +83,14 @@ THRESHOLDS: dict[str, float] = {
     # bench.py's config-1 headline — the single metric rounds r1–r5
     # carry, kept so the old trajectory chains into this gate
     "bls_batch_verify_sigs_per_sec": 0.5,
+    # chaos harness lines (tools/chaos_experiment.py): worst-case
+    # degraded-throughput retention across the scenario matrix, and
+    # slots-to-recovery after the last heal. Retention regressing past
+    # 25% of prior means a fault class started starving the pipeline;
+    # recovery_slots has a 0 prior, so the lower-is-better zero-prior
+    # branch gates it absolutely (anything past 2 slots fails).
+    "chaos_degraded_throughput_retention_pct": 0.25,
+    "chaos_recovery_slots": 2.0,
 }
 
 #: metrics where a LARGER value is the regression (latency, error pct,
@@ -95,6 +103,7 @@ LOWER_IS_BETTER: set = {
     "prep_launches_per_set_unfused",
     "e2e_launches_per_batch",
     "e2e_launches_per_batch_split",
+    "chaos_recovery_slots",
 }
 
 #: fallback for a metric a newer bench emits before its threshold
