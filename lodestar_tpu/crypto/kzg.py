@@ -180,18 +180,32 @@ def _commit_msm(g1, scalars, device: bool) -> bytes:
         from lodestar_tpu.ops import curve as cv
         from lodestar_tpu.ops import fp as fpo
         from lodestar_tpu.ops import msm
+        from lodestar_tpu.ops import prep as dp
         from lodestar_tpu.ops import tower as tw  # noqa: F401
 
-        xs = np.asarray(fpo.to_mont(fpo.limbs_from_ints([p[0] for p in g1])))
-        ys = np.asarray(fpo.to_mont(fpo.limbs_from_ints([p[1] for p in g1])))
+        # every device launch on this path rides the counted seam: the
+        # MSM itself counts inside ops/msm; the boundary conversions and
+        # the affine conversion are counted here
+        xs = np.asarray(
+            dp._dispatch(fpo.to_mont, fpo.limbs_from_ints([p[0] for p in g1]))
+        )
+        ys = np.asarray(
+            dp._dispatch(fpo.to_mont, fpo.limbs_from_ints([p[1] for p in g1]))
+        )
         bits = msm.bits_msb(scalars, 255)
         out = msm.msm_g1((xs, ys), bits)
-        aff = cv.jac_to_affine_batch(cv.F1, tuple(np.asarray(c)[None] for c in out))
+        aff = dp._dispatch(
+            cv.jac_to_affine_batch, cv.F1, tuple(np.asarray(c)[None] for c in out)
+        )
         z_zero = bool(np.all(np.asarray(out[2]) == 0))
         if z_zero:
             return g1_to_bytes(None)
-        x = fpo.int_from_limbs(np.asarray(fpo.from_mont(np.asarray(aff[0])[0])))
-        y = fpo.int_from_limbs(np.asarray(fpo.from_mont(np.asarray(aff[1])[0])))
+        x = fpo.int_from_limbs(
+            np.asarray(dp._dispatch(fpo.from_mont, np.asarray(aff[0])[0]))
+        )
+        y = fpo.int_from_limbs(
+            np.asarray(dp._dispatch(fpo.from_mont, np.asarray(aff[1])[0]))
+        )
         return g1_to_bytes((x, y))
     acc = None
     for pt, s in zip(g1, scalars):
@@ -202,18 +216,53 @@ def _commit_msm(g1, scalars, device: bool) -> bytes:
 
 # --- verification ------------------------------------------------------------
 
+_kzg_fallback_counter = None  # guarded by: GIL (prometheus Counter slot, set at node init)
+_kzg_fallbacks_total = 0  # guarded by: GIL (monotonic int; += under the GIL, test reads)
+
+
+def configure_kzg_fallback_counter(counter) -> None:
+    """Install the `lodestar_kzg_device_fallback_total` Counter (node
+    init); None leaves the process-local count only."""
+    global _kzg_fallback_counter
+    _kzg_fallback_counter = counter
+
+
+def kzg_device_fallbacks_total() -> int:
+    """Process-local count of device-pairing failures served by the CPU
+    oracle — the number the degradation tests assert against."""
+    return _kzg_fallbacks_total
+
+
+def _note_kzg_device_fallback(err: Exception) -> None:
+    global _kzg_fallbacks_total
+    _kzg_fallbacks_total += 1
+    c = _kzg_fallback_counter
+    if c is not None:
+        c.inc()
+    from lodestar_tpu.logger import get_logger
+
+    get_logger(name="lodestar.kzg").warn(
+        "device pairing check failed, serving the CPU oracle verdict",
+        {"error": str(err)[:120]},
+    )
+
 
 def _pairs_are_one_device(pairs) -> bool | None:
     """Run a pairing-product == 1 check on the DEVICE kernels
-    (ops/pairing.multi_pairing_is_one); None = device unavailable,
-    caller falls back to the CPU oracle. Infinity entries are masked
-    (pair contributes the neutral element, same as the oracle's
-    skip-None)."""
+    (ops/pairing.multi_pairing_is_one); None = device unavailable (no
+    ops stack on this host), caller falls back to the CPU oracle. A
+    RUNTIME device failure is a degradation, not an absence: it ticks
+    `lodestar_kzg_device_fallback_total` and serves the oracle verdict
+    directly. Infinity entries are masked (pair contributes the neutral
+    element, same as the oracle's skip-None); the batch axis is padded
+    to a power of two with masked-out generator rows so the pairing
+    program compiles per size class, not per pair count."""
     try:
         import numpy as np
 
         from lodestar_tpu.ops import fp
         from lodestar_tpu.ops import pairing as prg
+        from lodestar_tpu.ops import prep as dp
         from lodestar_tpu.ops import tower as tw
     except Exception:
         return None
@@ -227,15 +276,24 @@ def _pairs_are_one_device(pairs) -> bool | None:
         py.append(fp.mont_limbs_from_int(pp[1]))
         qx.append(tw._fp2_mont_limbs_host(*qq[0]))
         qy.append(tw._fp2_mont_limbs_host(*qq[1]))
+    size = dp.pad_pow2(len(mask), floor=2)
+    for _ in range(size - len(pairs)):
+        mask.append(False)  # padding rows: valid points, masked to one
+        px.append(px[0])
+        py.append(py[0])
+        qx.append(qx[0])
+        qy.append(qy[0])
     try:
-        ok = prg.multi_pairing_is_one(
+        ok = dp._dispatch(
+            prg.multi_pairing_is_one,
             (np.stack(px), np.stack(py)),
             (np.stack(qx), np.stack(qy)),
             mask=np.asarray(mask),
         )
         return bool(np.asarray(ok))
-    except Exception:
-        return None
+    except Exception as e:
+        _note_kzg_device_fallback(e)
+        return pairings_are_one(pairs)
 
 
 def verify_kzg_proof(

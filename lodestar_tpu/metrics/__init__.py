@@ -439,6 +439,16 @@ class SszHtrMetrics:
 
 
 @dataclass
+class KzgMetrics:
+    """lodestar_kzg_* — KZG blob verification (`crypto/kzg.py`): the
+    degrade-and-count observable for the device pairing check (device
+    error → CPU oracle verdict, counted where the degradation is
+    served)."""
+
+    device_fallbacks: Counter  # device pairing errors served by the CPU oracle
+
+
+@dataclass
 class TraceMetrics:
     """lodestar_trace_* — span-duration summaries derived from the
     per-slot pipeline tracer (`lodestar_tpu/tracing`): every completed
@@ -473,6 +483,7 @@ class BeaconMetrics:
     bls_pipeline: "BlsPipelineMetrics"
     device_launch: "DeviceLaunchMetrics"
     ssz_htr: "SszHtrMetrics"
+    kzg: "KzgMetrics"
     state_transition: StateTransitionMetrics
     gossip: GossipMetrics
     fork_choice: ForkChoiceMetrics
@@ -668,6 +679,13 @@ def create_metrics() -> BeaconMetrics:
             "lodestar_ssz_htr_fallback_total",
             "HTR degradations, by leg (flush: device error to CPU hasher; tracker: tracker error to value path)",
             ["leg"],
+        ),
+    )
+    kzg = KzgMetrics(
+        device_fallbacks=c.counter(
+            "lodestar_kzg_device_fallback_total",
+            "KZG device pairing failures served by the CPU oracle verdict "
+            "(counted where the degradation is served, crypto/kzg.py)",
         ),
     )
     st = StateTransitionMetrics(
@@ -1129,6 +1147,7 @@ def create_metrics() -> BeaconMetrics:
         bls_pipeline=bls_pipeline,
         device_launch=device_launch,
         ssz_htr=ssz_htr,
+        kzg=kzg,
         state_transition=st,
         gossip=gossip,
         fork_choice=fc,

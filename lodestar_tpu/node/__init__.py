@@ -362,6 +362,13 @@ class BeaconNode:
 
         configure_device_htr(mode=opts.htr_device, metrics=metrics.ssz_htr)
 
+        # KZG device-pairing degradation counter: process-global like the
+        # prep/HTR seams (the fallback happens inside crypto/kzg.py,
+        # below any node object)
+        from lodestar_tpu.crypto.kzg import configure_kzg_fallback_counter
+
+        configure_kzg_fallback_counter(metrics.kzg.device_fallbacks)
+
         # 2f. device launch telemetry: mode + the lodestar_device_launch_*
         # sink (process-global — the dispatch seams live in ops/ssz/mesh
         # layers below any node object); the slow-slot dump hook makes a

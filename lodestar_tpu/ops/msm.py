@@ -23,6 +23,7 @@ import numpy as np
 
 from . import curve as cv
 from . import fp
+from . import prep
 from . import tower as tw
 
 __all__ = ["bits_msb", "msm_g1", "msm_g2", "aggregate_points_g1"]
@@ -45,14 +46,18 @@ def msm_g1(points_aff, bit_matrix):
     int32 MSB-first. Returns a Jacobian point (no batch dim).
     Scalar 0 rows contribute infinity (their running point stays Z=0).
     """
-    acc = cv.scalar_mul_var(cv.F1, points_aff, bit_matrix, fp.one_mont(), exact=True)
-    return cv.fold_sum(cv.F1, acc)
+    acc = prep._dispatch(
+        cv.scalar_mul_var, cv.F1, points_aff, bit_matrix, fp.one_mont(), exact=True
+    )
+    return prep._dispatch(cv.fold_sum, cv.F1, acc)
 
 
 def msm_g2(points_aff, bit_matrix):
     """sum_i scalar_i * Q_i over the G2 twist ((N, 2, 33) coords)."""
-    acc = cv.scalar_mul_var(cv.F2, points_aff, bit_matrix, tw.fp2_one(), exact=True)
-    return cv.fold_sum(cv.F2, acc)
+    acc = prep._dispatch(
+        cv.scalar_mul_var, cv.F2, points_aff, bit_matrix, tw.fp2_one(), exact=True
+    )
+    return prep._dispatch(cv.fold_sum, cv.F2, acc)
 
 
 def aggregate_points_g1(points_aff):
@@ -61,4 +66,4 @@ def aggregate_points_g1(points_aff):
     x, y = points_aff
     one = fp.one_mont()
     jac = cv.affine_to_jac(cv.F1, (x, y), one)
-    return cv.fold_sum(cv.F1, jac)
+    return prep._dispatch(cv.fold_sum, cv.F1, jac)

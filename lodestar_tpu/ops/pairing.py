@@ -219,7 +219,11 @@ def final_exponentiation(f):
 
 def pairing(p_aff, q_aff):
     """Full batched (cubed) ate pairing e(P, Q)^3; no infinity inputs."""
-    return final_exponentiation(miller_loop(p_aff, q_aff))
+    # two programs, two counted launches (lazy import: prep pulls in the
+    # host oracle modules, which this module must not load at import)
+    from . import prep
+
+    return prep._dispatch(final_exponentiation, prep._dispatch(miller_loop, p_aff, q_aff))
 
 
 def fp12_product_fold(f, mask=None):

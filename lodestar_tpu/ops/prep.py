@@ -186,7 +186,7 @@ def prep_launches_total() -> int:
     return _launches_total
 
 
-def _dispatch(program, *args):
+def _dispatch(program, *args, **kwargs):
     global _launches_total
     _launches_total += 1
     c = _launch_counter
@@ -194,9 +194,11 @@ def _dispatch(program, *args):
         c.inc()
     # launch telemetry rides THE counted seam: wall time at the
     # dispatch call, program identity, and the padded batch size
-    # (the arrays arriving here are already size-class padded)
+    # (the arrays arriving here are already size-class padded; kwargs
+    # carry static_argnames-style knobs, not batch data, and stay out
+    # of the size-class probe)
     t0 = time.perf_counter() if telemetry.launch_telemetry_active() else 0.0
-    out = program(*args)
+    out = program(*args, **kwargs)
     if t0:
         telemetry.record_launch(
             telemetry.program_name(program),

@@ -179,7 +179,11 @@ def merkle_root_device(chunks) -> jax.Array:
     n = chunks.shape[0]
     if n & (n - 1):
         raise ValueError("chunk count must be a power of two")
-    return _merkle_root_fixed(chunks, depth=n.bit_length() - 1)
+    # the whole tree is ONE launch; count it at the shared seam (lazy
+    # import: see the import-time-compile note at the end of this file)
+    from . import prep
+
+    return prep._dispatch(_merkle_root_fixed, chunks, depth=n.bit_length() - 1)
 
 
 def words_from_bytes(data: bytes) -> np.ndarray:

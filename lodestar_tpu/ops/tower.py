@@ -75,14 +75,20 @@ __all__ = [
 
 def fp2_from_ints(vals) -> np.ndarray:
     """[(c0, c1), ...] -> (N, 2, 33) mont-form limbs (host-side)."""
+    # lazy import: prep imports this module at its top level
+    from . import prep
+
     out = np.stack(
         [np.stack([fp.limbs_from_int(c0), fp.limbs_from_int(c1)]) for c0, c1 in vals]
     )
-    return np.asarray(fp.to_mont(out))
+    # lint: allow(pow2-dispatch) — setup-time constant-table conversion; the shape comes from a fixed constant list, not per-batch data
+    return np.asarray(prep._dispatch(fp.to_mont, out))
 
 
 def fp2_to_ints(arr) -> list[tuple[int, int]]:
-    std = np.asarray(fp.from_mont(arr))
+    from . import prep
+
+    std = np.asarray(prep._dispatch(fp.from_mont, arr))
     flat = std.reshape(-1, 2, fp.LIMBS)
     return [(fp.int_from_limbs(e[0]), fp.int_from_limbs(e[1])) for e in flat]
 
@@ -150,7 +156,7 @@ def fp2_sq(a):
     return fp.redc(fp2_sq_acc(a))
 
 
-def fp2_mul_small(a, k: int):
+def fp2_mul_small(a, k: int):  # lint: allow(counted-dispatch) — trace-time Fp2 helper exported for jitted callers; no in-tree host call site, so the disciplined-scope fixpoint cannot see its (trace-only) users
     """Multiply by a small non-negative integer via repeated addition."""
     if k == 0:
         return fp2_zero(a.shape[:-2])

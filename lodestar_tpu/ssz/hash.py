@@ -17,21 +17,9 @@ import os
 
 import numpy as np
 
-# Lazy import keeps pure-host consumers (db, serdes) from paying JAX startup.
-_sha256_ops = None
-
 # Below this many pairs a level is hashed with hashlib; at or above it, the
 # batched device kernel wins (tunable for the deployment's interconnect).
 DEVICE_MIN_PAIRS = int(os.environ.get("LODESTAR_TPU_HASH_MIN_PAIRS", "2048"))
-
-
-def _ops():
-    global _sha256_ops
-    if _sha256_ops is None:
-        from lodestar_tpu.ops import sha256 as _mod
-
-        _sha256_ops = _mod
-    return _sha256_ops
 
 
 # The C++ batch hasher (SHA-NI / threaded) removes the per-pair Python
@@ -70,10 +58,15 @@ def hash_nodes_cpu(data: np.ndarray) -> np.ndarray:
 
 
 def hash_nodes_device(data: np.ndarray) -> np.ndarray:
-    """Hash adjacent 32-byte node pairs on the accelerator. data: (2N, 32) uint8."""
-    ops = _ops()
-    out_words = np.asarray(ops.merkle_level(ops.words_from_bytes(data.tobytes())))
-    return np.frombuffer(ops.bytes_from_words(out_words), dtype=np.uint8).reshape(-1, 32)
+    """Hash adjacent 32-byte node pairs on the accelerator. data: (2N, 32)
+    uint8. Routed through the counted `device_htr._device_level` seam so
+    size-class padding, the launches counter, and launch telemetry all
+    ride the one dispatch site (lazy import: device_htr imports this
+    module at its top level, and pure-host consumers must not pay JAX
+    startup)."""
+    from lodestar_tpu.ssz.device_htr import _device_level
+
+    return _device_level(data)
 
 
 def hash_nodes(data: np.ndarray) -> np.ndarray:

@@ -1,0 +1,17 @@
+"""Fixture jit bindings: lambda, partial(jax.jit), plain decorator."""
+
+import functools
+
+import jax
+
+summed = jax.jit(lambda x: x.sum())
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def scaled(x, k):
+    return x * k
+
+
+@jax.jit
+def folded(x):
+    return x.sum()

@@ -1,0 +1,28 @@
+"""Undisciplined call sites: every dispatch-evasion shape the
+counted-dispatch rule must catch."""
+
+import numpy as np
+
+from .ops import kernels
+from .ops.prep import doubled
+
+_WARM = doubled(np.zeros((8,), dtype=np.float32))  # module-level call
+
+
+def handle_batch(batch):
+    return doubled(np.asarray(batch))  # direct call of a jitted def
+
+
+def handle_lambda(batch):
+    return kernels.summed(np.asarray(batch))  # jit-wrapped lambda
+
+
+def handle_partial(batch):
+    return kernels.scaled(np.asarray(batch), 3)  # functools.partial(jax.jit)
+
+
+_FN = kernels.folded  # stored alias...
+
+
+def handle_stored(batch):
+    return _FN(np.asarray(batch))  # ...then dispatched

@@ -1,0 +1,11 @@
+"""Trace-time composition: calling a jitted callable inside another
+jitted body is inlining, not a dispatch."""
+
+import jax
+
+from .prep import doubled
+
+
+@jax.jit
+def composed(x):
+    return doubled(x) + 1

@@ -1,0 +1,17 @@
+"""Fixture seam module: the counted `_dispatch` plus jitted programs."""
+
+import jax
+
+
+@jax.jit
+def doubled(x):
+    return x * 2
+
+
+@jax.jit
+def folded(x):
+    return x.sum()
+
+
+def _dispatch(program, *args):
+    return program(*args)

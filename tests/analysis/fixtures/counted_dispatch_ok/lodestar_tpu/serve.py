@@ -1,0 +1,17 @@
+"""Disciplined call sites: seams, trace bodies, storage tables."""
+
+import numpy as np
+
+from .ops import kernels, prep
+
+# module-level STORAGE of a jitted callable (the _FieldOps
+# static-argument-table shape): not a call, must not poison the fixpoint
+_OPS = {"fold": prep.folded, "compose": kernels.composed}
+
+
+def handle_batch(batch):
+    return prep._dispatch(prep.doubled, np.asarray(batch))
+
+
+def handle_fold(batch):
+    return prep._dispatch(prep.folded, np.asarray(batch))

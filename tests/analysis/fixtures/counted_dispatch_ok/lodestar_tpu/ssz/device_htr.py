@@ -1,0 +1,13 @@
+"""Fixture seam module exercising the disciplined fixpoint: `_run` is
+referenced only from the `_device_level` seam, so its direct jitted
+call is a counted launch."""
+
+from ..ops import prep
+
+
+def _device_level(data):
+    return _run(data)
+
+
+def _run(data):
+    return prep.doubled(data)

@@ -1,0 +1,17 @@
+"""datetime.now()/utcnow() in duration/deadline math — the same NTP
+hazard as time.time(), through both import spellings."""
+
+import datetime
+from datetime import datetime as dt
+
+
+def deadline_passed(deadline):
+    return dt.utcnow() > deadline
+
+
+def elapsed_s(start):
+    return (datetime.datetime.now() - start).total_seconds()
+
+
+def extend(budget):
+    return dt.now() + budget

@@ -1,0 +1,18 @@
+"""Timestamp-only datetime uses: logging, persisting, timedelta math
+with no wall read inside the arithmetic — all legal."""
+
+import datetime
+from datetime import datetime as dt, timedelta
+
+
+def stamp():
+    return dt.utcnow().isoformat()
+
+
+def annotate(record):
+    record["at"] = datetime.datetime.now()
+    return record
+
+
+def add_grace(when):
+    return when + timedelta(seconds=30)

@@ -1,0 +1,12 @@
+"""Fixture seam module: the counted `_dispatch` plus a jitted program."""
+
+import jax
+
+
+@jax.jit
+def doubled(x):
+    return x * 2
+
+
+def _dispatch(program, *args):
+    return program(*args)

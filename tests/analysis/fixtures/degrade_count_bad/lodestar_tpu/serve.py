@@ -1,0 +1,45 @@
+"""Device-dispatch excepts that neither count nor route: every
+degradation-chain failure shape."""
+
+from .ops import prep
+from .ssz.device_htr import _device_level
+
+
+def swallow(batch):
+    try:
+        return prep._dispatch(prep.doubled, batch)
+    except Exception:
+        return None  # silent: no counter, dead-end verdict
+
+
+def route_without_count(batch):
+    try:
+        return prep._dispatch(prep.doubled, batch)
+    except Exception:
+        return cpu_verify(batch)  # host path, but the degradation is uncounted
+
+
+def wrong_counter(batch, metrics):
+    try:
+        return prep._dispatch(prep.doubled, batch)
+    except Exception:
+        metrics.errors.inc()  # a counter, but not a *fallback* family
+        return None
+
+
+def log_only(batch, log):
+    try:
+        return prep.doubled(batch)
+    except Exception as e:
+        log.warn(str(e))  # falls through, but the degradation is uncounted
+
+
+def flush_stored(runner, rows):
+    try:
+        return runner(_device_level, rows)  # seam passed as an argument
+    except Exception:
+        return None
+
+
+def cpu_verify(batch):
+    return batch

@@ -1,0 +1,5 @@
+"""Fixture seam module for the stored-then-dispatched shape."""
+
+
+def _device_level(data):
+    return data

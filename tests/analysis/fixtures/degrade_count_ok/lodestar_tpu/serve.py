@@ -1,0 +1,60 @@
+"""Degrade-and-count compliant handlers: count + route, re-raise,
+counted fall-through, and a trace-time try (exempt)."""
+
+import jax
+
+from .ops import prep
+from .ops.prep import doubled
+
+
+def verify(batch):
+    try:
+        return prep._dispatch(prep.doubled, batch)
+    except Exception as e:
+        note_fallback(e)
+        return cpu_verify(batch)  # count + named host path
+
+
+def convert(batch):
+    try:
+        return prep._dispatch(prep.doubled, batch)
+    except ValueError as e:
+        raise RuntimeError("bad batch") from e  # propagation, not degradation
+
+
+def build_inputs(rows, m_fallbacks):
+    out = None
+    try:
+        out = prep._dispatch(prep.doubled, rows)
+    except Exception:
+        m_fallbacks.labels("prep").inc()  # counted; host path is fall-through
+    if out is None:
+        out = host_prep(rows)
+    return out
+
+
+@jax.jit
+def traced(x):
+    try:
+        return doubled(x)
+    except TypeError:
+        return x  # trace-time try: runs at trace, not at dispatch
+
+
+def parse(blob):
+    try:
+        return int(blob)
+    except ValueError:
+        return None  # no device dispatch in the body: out of scope
+
+
+def note_fallback(err):
+    return err
+
+
+def cpu_verify(batch):
+    return batch
+
+
+def host_prep(rows):
+    return rows

@@ -1,0 +1,23 @@
+"""Deliberately impure jitted bodies: every jit-purity hazard class."""
+
+import jax
+import numpy as np
+
+
+def helper_sync(a):
+    return a.mean().item()  # .item() in a helper reached from a jit root
+
+
+@jax.jit
+def root_hazards(x, y):
+    v = x.sum().item()  # host sync mid-trace
+    w = int(y)  # concretizes a traced param
+    t = np.cumsum(x)  # host numpy fed by a traced param
+    if x > 0:  # Python branch on the tracer
+        w = w + 1
+    for i in range(len(x)):  # trace unrolled per batch length
+        w = w + i
+    return v + w + t + helper_sync(x)
+
+
+summed_sq = jax.jit(lambda v: np.square(v))  # np in a jit-wrapped lambda

@@ -1,0 +1,49 @@
+"""Pure jitted bodies: static args, trace-time constants, device-side
+flow — every exemption the jit-purity rule promises."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scalar_mul(x, scalar):
+    # static-exponent bit table: np fed by a STATIC param is a legal
+    # trace-time constant (the curve.scalar_mul_const shape)
+    bits = np.array([int(b) for b in bin(scalar)[2:]], dtype=np.int32)
+    acc = jnp.zeros_like(x)
+    for b in bits.tolist():
+        acc = acc + x * b
+    return acc
+
+
+@jax.jit
+def shifted(x, n: int):
+    if n > 2:  # plain-int annotation: a trace-time Python value
+        return x * 2
+    return x
+
+
+@jax.jit
+def masked_sum(x, mask=None):
+    if mask is None:  # identity test: trace-time, not a tracer branch
+        return x.sum()
+    return (x * mask).sum()
+
+
+def tail_shape(a):
+    return jnp.arange(a.shape[0])  # .shape access is trace-static
+
+
+@jax.jit
+def with_helper(x):
+    return x + tail_shape(x)
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def fold(x, depth):
+    for _ in range(depth):  # loop over a static, not range(len(traced))
+        x = x + x
+    return x

@@ -1,0 +1,18 @@
+"""Raw host-constructed shapes reaching counted seams, unpadded: one
+XLA compile per batch size."""
+
+import numpy as np
+
+
+def verify_blobs(prg, blobs):
+    rows = np.stack([np.frombuffer(b, dtype=np.uint8) for b in blobs])
+    return _dispatch(prg, rows)  # assignment-chain slice bottoms out raw
+
+
+def flush_level(nodes):
+    data = np.concatenate(nodes).reshape(-1, 32)
+    return _device_level(data)  # chained .reshape does not launder the shape
+
+
+def check_batch(msgs):
+    return device_batch_verify(np.asarray(msgs))  # raw constructor inline

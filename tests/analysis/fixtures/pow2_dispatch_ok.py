@@ -1,0 +1,24 @@
+"""Padded, seam-chained, and pass-through flows at the counted seams —
+every quiet verdict the pow2-dispatch rule promises."""
+
+import numpy as np
+
+
+def pad_rows(arr, size):
+    pad = np.zeros((size - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+def verify_blobs(prg, blobs):
+    rows = np.stack([np.frombuffer(b, dtype=np.uint8) for b in blobs])
+    rows = pad_rows(rows, 8)  # shared padder on the path
+    return _dispatch(prg, rows)
+
+
+def two_stage(prg_a, prg_b, padded):
+    acc = _dispatch(prg_a, padded)  # parameter: padded upstream (unknown)
+    return _dispatch(prg_b, acc)  # seam output: padded by construction
+
+
+def forward(batch):
+    return device_batch_verify(batch)  # pass-through, checked at the caller

@@ -1,0 +1,19 @@
+"""Real-clock reads in deterministic-harness (testing/) code: each one
+silently reintroduces real time into a simulated run."""
+
+import time
+from time import monotonic
+
+
+class Prober:
+    def __init__(self, clock=None):
+        self.clock = clock
+        self.started = time.monotonic_ns()  # unconditional read
+
+    def probe(self):
+        return monotonic()  # from-import spelling, still a read
+
+
+def stamp_event(event):
+    event["at"] = time.time()  # timestamp, but the harness must use SimClock
+    return event

@@ -1,0 +1,21 @@
+"""SimClock-disciplined harness code: the injected clock is
+authoritative; the real clock appears only behind clock-is-None guards
+or as a function value."""
+
+import time
+
+
+class Prober:
+    def __init__(self, clock=None):
+        self.clock = clock
+        # a function VALUE is a reference, not a read
+        self.time_fn = clock.monotonic_ns if clock is not None else time.monotonic_ns
+
+    def now(self):
+        if self.clock is None:
+            return time.time()  # guarded fallback: the legal idiom
+        return self.clock.time()
+
+    def elapsed(self, t0):
+        now_s = self.clock.time() if self.clock is not None else time.time()
+        return now_s - t0

@@ -43,9 +43,13 @@ def test_gen_alerts_regen_is_noop():
     assert res.returncode == 0, res.stdout + res.stderr
 
 
-def test_at_least_six_rules_registered():
-    assert len(ALL_RULES) >= 6
+def test_all_fifteen_rules_registered():
+    assert len(ALL_RULES) >= 15
     assert len({r.name for r in ALL_RULES}) == len(ALL_RULES)
+    names = {r.name for r in ALL_RULES}
+    # the dispatch-doctrine quartet is present
+    assert {"counted-dispatch", "jit-purity", "pow2-dispatch",
+            "degrade-and-count"} <= names
 
 
 def cli(*argv):
@@ -96,3 +100,35 @@ def test_cli_list_rules_names_every_rule():
     assert res.returncode == 0
     for rule in ALL_RULES:
         assert rule.name in res.stdout
+
+
+def test_cli_exit_codes_cover_the_dispatch_rules():
+    res = cli("--rule", "jit-purity", str(FIXTURES / "jit_purity_bad.py"))
+    assert res.returncode == 1
+    assert "jit-purity" in res.stdout
+    res = cli("--rule", "pow2-dispatch", str(FIXTURES / "pow2_dispatch_bad.py"))
+    assert res.returncode == 1
+    assert "pow2-dispatch" in res.stdout
+
+
+def test_cli_stats_prints_per_rule_accounting():
+    res = cli(
+        "--stats", "--rule", "monotonic-durations", str(FIXTURES / "monotonic_ok.py")
+    )
+    assert res.returncode == 0
+    assert res.stdout == ""
+    assert "monotonic-durations" in res.stderr
+    assert "finding(s)" in res.stderr
+
+
+def test_cli_changed_scopes_to_modified_files(tmp_path):
+    """--changed intersects git's changed files with the given paths: a
+    violating file OUTSIDE the repo's change set is skipped (exit 0),
+    while a plain run on the same path fails."""
+    bad = tmp_path / "clock_bad.py"
+    bad.write_text("import time\nd = time.time() - 0\n")
+    res = cli("--changed", "--rule", "monotonic-durations", str(tmp_path))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "no modified Python files" in res.stderr
+    res = cli("--rule", "monotonic-durations", str(tmp_path))
+    assert res.returncode == 1

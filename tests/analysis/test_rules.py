@@ -31,6 +31,10 @@ CASES = [
     ("fail-closed-verdicts", "fail_closed_bad.py", 3, "fail_closed_ok.py"),
     ("span-discipline", "span_bad.py", 2, "span_ok.py"),
     ("monotonic-durations", "monotonic_bad.py", 3, "monotonic_ok.py"),
+    ("monotonic-durations", "datetime_bad.py", 3, "datetime_ok.py"),
+    ("monotonic-durations", "testing/simclock_bad.py", 3, "testing/simclock_ok.py"),
+    ("jit-purity", "jit_purity_bad.py", 7, "jit_purity_ok.py"),
+    ("pow2-dispatch", "pow2_dispatch_bad.py", 3, "pow2_dispatch_ok.py"),
 ]
 
 
@@ -87,6 +91,50 @@ def test_blocking_under_lock_details():
     assert "timeout= call" in msgs
     assert "future.result()" in msgs
     assert "worker_thread.join()" in msgs
+
+
+def test_datetime_wall_reads_flag_both_import_spellings():
+    msgs = " | ".join(f.message for f in run_rule("monotonic-durations", "datetime_bad.py"))
+    assert "wall-clock read" in msgs
+    # both `datetime.datetime.now()` and the class-alias `dt.utcnow()`
+    findings = run_rule("monotonic-durations", "datetime_bad.py")
+    assert {f.line for f in findings} == {9, 13, 17}
+
+
+def test_simclock_check_details():
+    findings = run_rule("monotonic-durations", "testing/simclock_bad.py")
+    assert all("SimClock" in f.message for f in findings)
+    # the ok fixture's guarded ternary / if-guard / function-value
+    # idioms are exactly the real fleet.py shapes — all quiet (CASES)
+
+
+def test_simclock_check_only_applies_under_testing_paths():
+    """The same unconditional reads OUTSIDE a testing/ directory are the
+    wall-clock-arithmetic rule's business only — monotonic_ok.py-style
+    timestamp reads in product code stay legal."""
+    findings = run_rule("monotonic-durations", "datetime_ok.py")
+    assert findings == []
+
+
+def test_jit_purity_flags_every_hazard_class():
+    findings = run_rule("jit-purity", "jit_purity_bad.py")
+    msgs = " | ".join(f.message for f in findings)
+    assert ".item() inside jitted 'root_hazards'" in msgs
+    assert "int(y) concretizes a traced parameter" in msgs
+    assert "np.cumsum(...) inside jitted 'root_hazards'" in msgs
+    assert "Python if on traced parameter 'x'" in msgs
+    assert "range(len(...)) over a traced parameter" in msgs
+    # helpers reached from a jit root get the host-sync checks
+    assert ".item() inside 'helper_sync' (reached from a jitted body)" in msgs
+    # jit-wrapped lambdas are roots too
+    assert "np.square(...) inside jitted '<lambda>'" in msgs
+
+
+def test_pow2_dispatch_details():
+    findings = run_rule("pow2-dispatch", "pow2_dispatch_bad.py")
+    seams = {f.message.split("'")[1] for f in findings}
+    assert seams == {"_dispatch", "_device_level", "device_batch_verify"}
+    assert all("one XLA compile per batch size" in f.message for f in findings)
 
 
 # -- suppression pragmas ------------------------------------------------------
@@ -415,6 +463,86 @@ def test_alert_wiring_real_tree_is_clean():
     findings = analyze(
         [],
         rules=[RULES_BY_NAME["alert-wiring"]],
+        repo_root=repo,
+        pragma_hygiene=False,
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+# -- counted-dispatch (project-scoped) ----------------------------------------
+
+
+def dispatch_findings(root: str, rule: str = "counted-dispatch"):
+    return analyze(
+        [],
+        rules=[RULES_BY_NAME[rule]],
+        repo_root=FIXTURES / root,
+        pragma_hygiene=False,
+    )
+
+
+def test_counted_dispatch_flags_every_evasion_shape():
+    """The reference-graph edge cases from the dispatch doctrine: a
+    direct jitted call, a module-level call, a jit-wrapped lambda, a
+    functools.partial(jax.jit) def, and a stored-then-dispatched
+    alias."""
+    findings = dispatch_findings("counted_dispatch_bad")
+    joined = " | ".join(f.message for f in findings)
+    assert "'lodestar_tpu.ops.prep.doubled' called at module level" in joined
+    assert "'lodestar_tpu.ops.prep.doubled' called in 'handle_batch'" in joined
+    assert "'lodestar_tpu.ops.kernels.summed' called in 'handle_lambda'" in joined
+    assert "'lodestar_tpu.ops.kernels.scaled' called in 'handle_partial'" in joined
+    assert "'lodestar_tpu.serve._FN' called in 'handle_stored'" in joined
+    assert all("invisible to the launch counters" in f.message for f in findings)
+    assert len(findings) == 5, joined
+
+
+def test_counted_dispatch_clean_tree():
+    """Quiet on: seam-routed dispatch, trace-time inlining, the
+    disciplined-scope fixpoint (a helper referenced only from a seam),
+    and module-level storage tables (no fixpoint poisoning)."""
+    assert dispatch_findings("counted_dispatch_ok") == []
+
+
+def test_counted_dispatch_real_tree_is_clean():
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    findings = analyze(
+        [],
+        rules=[RULES_BY_NAME["counted-dispatch"]],
+        repo_root=repo,
+        pragma_hygiene=False,
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+# -- degrade-and-count (project-scoped) ---------------------------------------
+
+
+def test_degrade_and_count_flags_every_failure_shape():
+    findings = dispatch_findings("degrade_count_bad", rule="degrade-and-count")
+    msgs = [f.message for f in findings]
+    joined = " | ".join(msgs)
+    # silent swallow: both halves missing
+    assert sum("ticks no *fallback* counter" in m and "names no host path" in m
+               for m in msgs) >= 2  # swallow + flush_stored + wrong_counter
+    # routes but uncounted (return cpu_verify / log-only fall-through)
+    assert sum("ticks no *fallback* counter" in m and "names no host path" not in m
+               for m in msgs) == 2
+    assert "degrade-and-count: count the fallback" in joined
+    assert len(findings) == 5, joined
+
+
+def test_degrade_and_count_clean_tree():
+    """Quiet on: count+route handlers, re-raise, counted fall-through,
+    trace-time trys, and trys with no device dispatch in the body."""
+    assert dispatch_findings("degrade_count_ok", rule="degrade-and-count") == []
+
+
+def test_degrade_and_count_real_tree_is_clean():
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    findings = analyze(
+        [],
+        rules=[RULES_BY_NAME["degrade-and-count"]],
         repo_root=repo,
         pragma_hygiene=False,
     )

@@ -1,14 +1,21 @@
-"""CLI: ``python -m tools.analysis [--rule NAME ...] [paths...]``.
+"""CLI: ``python -m tools.analysis [--rule NAME ...] [--changed] [paths...]``.
 
 Prints ``path:line rule message`` per finding and exits non-zero when
 anything fired. Default paths: ``lodestar_tpu/`` relative to the repo
 root (so a bare ``python -m tools.analysis`` from the repo root checks
 the whole tree).
+
+``--changed`` restricts per-file rules to Python files modified vs HEAD
+(plus untracked ones) under the requested paths — the pre-commit fast
+path. Project-scoped rules (wiring, counted-dispatch, ...) still scan
+the whole tree: their findings are global properties a single-file diff
+can silently break from the other end of a reference edge.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -17,6 +24,25 @@ from .core import analyze
 from .rules import ALL_RULES, RULES_BY_NAME
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _changed_py_files(repo_root: Path) -> list[str] | None:
+    """Python files changed vs HEAD plus untracked ones, as absolute
+    paths; None when git is unavailable (caller falls back to full
+    paths rather than silently skipping the gate)."""
+    names: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=repo_root, capture_output=True, text=True, check=True
+            )
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        names.update(ln.strip() for ln in proc.stdout.splitlines() if ln.strip())
+    return [str(repo_root / n) for n in sorted(names) if n.endswith(".py")]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -36,7 +62,15 @@ def main(argv: list[str] | None = None) -> int:
         "--list-rules", action="store_true", help="list rules and exit"
     )
     ap.add_argument(
-        "--stats", action="store_true", help="print file/timing summary"
+        "--changed",
+        action="store_true",
+        help="per-file rules only on files changed vs HEAD (+ untracked) "
+        "under the given paths; project rules still scan the whole tree",
+    )
+    ap.add_argument(
+        "--stats",
+        action="store_true",
+        help="print per-rule finding counts and wall time",
     )
     ap.add_argument("paths", nargs="*", help="files or directories (default: lodestar_tpu/)")
     args = ap.parse_args(argv)
@@ -56,11 +90,40 @@ def main(argv: list[str] | None = None) -> int:
         rules = [RULES_BY_NAME[n] for n in dict.fromkeys(args.rule)]
 
     paths = args.paths or [str(REPO_ROOT / "lodestar_tpu")]
+    if args.changed:
+        changed = _changed_py_files(REPO_ROOT)
+        if changed is None:
+            print(
+                "--changed: git unavailable, analyzing the full paths",
+                file=sys.stderr,
+            )
+        else:
+            roots = [Path(p).resolve() for p in paths]
+            paths = [
+                c
+                for c in changed
+                if any(Path(c).resolve().is_relative_to(r) for r in roots)
+            ]
+            if not paths:
+                print(
+                    "--changed: no modified Python files under the given paths",
+                    file=sys.stderr,
+                )
+                return 0
+
+    stats: dict = {}
     t0 = time.monotonic()
-    findings = analyze(paths, rules=rules, repo_root=REPO_ROOT)
+    findings = analyze(paths, rules=rules, repo_root=REPO_ROOT, stats=stats)
     dt = time.monotonic() - t0
     for f in findings:
         print(f.format())
+    if args.stats:
+        for name in sorted(stats, key=lambda n: -stats[n]["seconds"]):
+            s = stats[name]
+            print(
+                f"{name:24s} {s['findings']:4d} finding(s) {s['seconds']:7.2f}s",
+                file=sys.stderr,
+            )
     if args.stats or findings:
         n_rules = len(rules) if rules is not None else len(ALL_RULES)
         print(

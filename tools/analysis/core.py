@@ -23,6 +23,7 @@ from __future__ import annotations
 import ast
 import io
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -33,6 +34,7 @@ __all__ = [
     "SourceFile",
     "Rule",
     "analyze",
+    "cached_source",
     "iter_py_files",
 ]
 
@@ -166,6 +168,27 @@ class Rule:
         return ()
 
 
+def cached_source(sources, path) -> SourceFile | None:
+    """The one parsed-AST cache shared across a run: project rules load
+    files through here so the same module is parsed once no matter how
+    many rules scan it. `sources` is the resolved-path-keyed dict
+    `analyze()` passes to `check_project` (None falls back to a plain
+    load). Unreadable/missing files return None."""
+    p = Path(path)
+    key = str(p.resolve())
+    sf = sources.get(key) if sources is not None else None
+    if sf is None:
+        if p.suffix != ".py" or not p.is_file():
+            return None
+        try:
+            sf = SourceFile.load(p)
+        except OSError:
+            return None
+        if sources is not None:
+            sources[key] = sf
+    return sf
+
+
 def iter_py_files(paths) -> list[Path]:
     out: list[Path] = []
     for p in paths:
@@ -185,13 +208,17 @@ def analyze(
     rules=None,
     repo_root: Path | None = None,
     pragma_hygiene: bool | None = None,
+    stats: dict | None = None,
 ) -> list[Finding]:
     """Run `rules` (default: all registered) over `paths`. Project-scoped
     rules run once against `repo_root` (default: this repo). Returns the
     unsuppressed findings, sorted; on full-rule runs, stale/malformed
     pragmas are reported under the `pragma` rule (`pragma_hygiene`
     overrides that default — tests exercise hygiene against a single
-    rule without paying for the project-scoped ones)."""
+    rule without paying for the project-scoped ones). Pass a dict as
+    `stats` to receive per-rule accounting:
+    ``{rule_name: {"findings": n, "seconds": s}}`` (findings counted
+    AFTER suppression — the number the operator actually sees)."""
     from .rules import ALL_RULES
 
     selected = list(ALL_RULES) if rules is None else list(rules)
@@ -224,7 +251,9 @@ def analyze(
             findings.append(Finding("parse", sf.path, 1, f"syntax error: {sf.parse_error}"))
 
     raw: list[Finding] = []
+    rule_seconds: dict[str, float] = {}
     for rule in selected:
+        t0 = time.monotonic()
         if rule.scope == "project":
             raw.extend(rule.check_project(repo_root, sources=sources))
         else:
@@ -232,7 +261,9 @@ def analyze(
                 sf = sources[path]
                 if sf.tree is not None:
                     raw.extend(rule.check(sf))
+        rule_seconds[rule.name] = time.monotonic() - t0
 
+    kept_by_rule: dict[str, int] = {}
     for fnd in raw:
         sf = source_for(fnd.path)
         if sf is not None:
@@ -240,7 +271,15 @@ def analyze(
             if p is not None:
                 p.used = True
                 continue
+        kept_by_rule[fnd.rule] = kept_by_rule.get(fnd.rule, 0) + 1
         findings.append(fnd)
+
+    if stats is not None:
+        for rule in selected:
+            stats[rule.name] = {
+                "findings": kept_by_rule.get(rule.name, 0),
+                "seconds": rule_seconds.get(rule.name, 0.0),
+            }
 
     if full_run:
         # pragma hygiene only for files the caller actually analyzed —

@@ -5,10 +5,14 @@ from __future__ import annotations
 from .alert_wiring import AlertWiringRule
 from .bench_wiring import BenchWiringRule
 from .blocking_under_lock import BlockingUnderLockRule
+from .counted_dispatch import CountedDispatchRule
+from .degrade_count import DegradeAndCountRule
 from .fail_closed import FailClosedVerdictsRule
 from .fault_wiring import FaultWiringRule
+from .jit_purity import JitPurityRule
 from .lock_discipline import LockDisciplineRule
 from .monotonic import MonotonicDurationsRule
+from .pow2_dispatch import Pow2DispatchRule
 from .rest_wiring import RestRouteWiringRule
 from .span_discipline import SpanDisciplineRule
 from .tuning_provenance import TuningProvenanceRule
@@ -26,6 +30,10 @@ ALL_RULES = (
     BenchWiringRule(),
     AlertWiringRule(),
     TuningProvenanceRule(),
+    CountedDispatchRule(),
+    JitPurityRule(),
+    Pow2DispatchRule(),
+    DegradeAndCountRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -1,0 +1,360 @@
+"""Shared device-dispatch index for the dispatch-discipline rules.
+
+The four dispatch rules (counted-dispatch, jit-purity, pow2-dispatch,
+degrade-and-count) all need the same facts about the tree: which names
+are bound to jit-wrapped callables (decorator, ``name = jax.jit(...)``
+assignment, lambda, alias), which function bodies are TRACE-TIME (a
+call of a jitted callable inside another jitted body is inlining, not a
+dispatch), how imports map local names onto other modules' functions,
+and which functions are the counted seams. This module computes that
+once per run — per-module ``ModuleInfo`` plus a cross-module
+``DeviceIndex`` — reusing the parsed-AST cache ``analyze()`` hands to
+project rules.
+
+Resolution is by NAME through explicit imports (``from . import curve
+as cv`` → ``cv.fold_sum``; ``from .hash import hash_nodes_cpu``),
+including function-level imports. Dynamic storage (dicts of callables,
+``getattr``) is invisible — the same naming-discipline approximation as
+the PR 7 loop-confined checker, and the reason the rules stay
+suppressible with a written reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..core import SourceFile, cached_source, iter_py_files
+
+#: The counted dispatch seams: every device launch must be reachable
+#: only through these (repo-relative module path, function-name glob).
+SEAMS = (
+    ("lodestar_tpu/ops/prep.py", "_dispatch"),
+    ("lodestar_tpu/ssz/device_htr.py", "_device_level"),
+    ("lodestar_tpu/chain/bls/mesh.py", "mesh_launch"),
+    ("lodestar_tpu/models/batch_verify.py", "device_batch_verify*"),
+)
+
+#: jax transforms whose callable arguments execute at TRACE time — a
+#: function handed to one of these is a trace root, and the handoff
+#: itself is a registration, not a call/dispatch. Includes the lax
+#: control-flow primitives: a fori_loop/scan body runs as part of the
+#: enclosing trace, not as its own dispatch.
+_TRACE_WRAPPERS = {
+    "jit",
+    "vmap",
+    "pmap",
+    "shard_map",
+    "grad",
+    "value_and_grad",
+    "checkpoint",
+    "custom_jvp",
+    "custom_vjp",
+    "fori_loop",
+    "while_loop",
+    "scan",
+    "cond",
+    "switch",
+    "associative_scan",
+}
+
+
+def last_segment(node: ast.AST) -> str | None:
+    """Final dotted segment of a Name/Attribute expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` as a bare reference."""
+    return last_segment(node) == "jit"
+
+
+def is_jit_call(call: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    seg = last_segment(call.func)
+    if seg == "jit":
+        return True
+    return seg == "partial" and bool(call.args) and _is_jit_expr(call.args[0])
+
+
+def is_trace_wrapper_call(call: ast.Call) -> bool:
+    """A call whose callable arguments are trace-time registrations."""
+    seg = last_segment(call.func)
+    if seg in _TRACE_WRAPPERS:
+        return True
+    return seg == "partial" and bool(call.args) and (
+        last_segment(call.args[0]) in _TRACE_WRAPPERS
+    )
+
+
+def _const_tuple(node: ast.AST) -> tuple:
+    """Literal ints/strs out of a constant or tuple-of-constants."""
+    if isinstance(node, ast.Constant):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts if isinstance(e, ast.Constant)
+        )
+    return ()
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return []
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def static_params(call: ast.Call, fn: ast.AST | None) -> set[str]:
+    """Param names pinned static by ``static_argnums``/``static_argnames``
+    keywords on a jit/partial call (positional indices need the wrapped
+    function's signature)."""
+    out: set[str] = set()
+    names = _param_names(fn) if fn is not None else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out.update(v for v in _const_tuple(kw.value) if isinstance(v, str))
+        elif kw.arg == "static_argnums":
+            for v in _const_tuple(kw.value):
+                if isinstance(v, int) and 0 <= v < len(names):
+                    out.add(names[v])
+    return out
+
+
+@dataclass
+class ModuleInfo:
+    """Per-module dispatch facts (see module docstring)."""
+
+    rel: str  # posix path relative to repo root
+    sf: SourceFile
+    #: local name -> static param names, for every name bound to a
+    #: jit-wrapped callable (decorated def, jit assignment, alias)
+    jit_names: dict[str, set[str]] = field(default_factory=dict)
+    #: id() of def/lambda nodes whose BODY runs at trace time (jit/vmap
+    #: decorated, or registered with a trace wrapper)
+    trace_root_defs: set[int] = field(default_factory=set)
+    #: id() of Name/Attribute nodes that are wrapper registrations
+    #: (``jax.jit(f)``'s f) — not references, not calls
+    registration_refs: set[int] = field(default_factory=set)
+    #: local alias -> other module's rel path (``from x import mod as m``)
+    mod_alias: dict[str, str] = field(default_factory=dict)
+    #: local alias -> (module rel path, symbol) for symbol imports
+    sym_alias: dict[str, tuple[str, str]] = field(default_factory=dict)
+    #: top-level-visible function defs by name (methods included — the
+    #: reference graph is name-keyed, like the loop-confined checker)
+    func_defs: dict[str, list[ast.AST]] = field(default_factory=dict)
+
+    @property
+    def tree(self) -> ast.Module:
+        return self.sf.tree
+
+
+def _module_rel(base_parts: list[str], files: set[str]) -> str | None:
+    """Resolve dotted-module parts to a repo-relative file among the
+    indexed files (``a/b.py`` or ``a/b/__init__.py``)."""
+    base = "/".join(base_parts)
+    for cand in (base + ".py", base + "/__init__.py"):
+        if cand in files:
+            return cand
+    return None
+
+
+def _collect_imports(mi: ModuleInfo, files: set[str]) -> None:
+    pkg_parts = mi.rel.split("/")[:-1]
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname is None:
+                    continue  # bare `import a.b` binds the root name only
+                rel = _module_rel(a.name.split("."), files)
+                if rel is not None:
+                    mi.mod_alias[a.asname] = rel
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = (node.module or "").split(".") if node.module else []
+            else:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                if node.module:
+                    base = base + node.module.split(".")
+            if not base:
+                continue
+            base_rel = _module_rel(base, files)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound = a.asname or a.name
+                sub = _module_rel(base + [a.name], files)
+                if sub is not None:
+                    mi.mod_alias[bound] = sub
+                elif base_rel is not None:
+                    mi.sym_alias[bound] = (base_rel, a.name)
+
+
+def _collect_defs_and_jit(mi: ModuleInfo) -> None:
+    tree = mi.tree
+    defs_by_name = mi.func_defs
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    if is_jit_call(dec):
+                        mi.jit_names[node.name] = static_params(dec, node)
+                        mi.trace_root_defs.add(id(node))
+                    elif is_trace_wrapper_call(dec):
+                        mi.trace_root_defs.add(id(node))
+                elif _is_jit_expr(dec):
+                    mi.jit_names[node.name] = set()
+                    mi.trace_root_defs.add(id(node))
+                elif last_segment(dec) in _TRACE_WRAPPERS:
+                    mi.trace_root_defs.add(id(node))
+        elif isinstance(node, ast.Call) and is_trace_wrapper_call(node):
+            # every callable-looking argument is a registration; named
+            # local defs and inline lambdas become trace roots
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    mi.trace_root_defs.add(id(arg))
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    mi.registration_refs.add(id(arg))
+                    seg = last_segment(arg)
+                    for fn in defs_by_name.get(seg, ()):
+                        mi.trace_root_defs.add(id(fn))
+                elif isinstance(arg, ast.Call) and is_trace_wrapper_call(arg):
+                    pass  # nested jax.jit(jax.vmap(f)) — inner visit covers f
+
+    # `name = jax.jit(...)` / `name = jax.jit(jax.vmap(f))` assignments
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) and is_jit_call(value):
+            wrapped = value.args[0] if value.args else None
+            fn = None
+            if isinstance(wrapped, ast.Name):
+                fns = defs_by_name.get(wrapped.id, ())
+                fn = fns[0] if fns else None
+            elif isinstance(wrapped, ast.Lambda):
+                fn = wrapped
+            mi.jit_names[target.id] = static_params(value, fn)
+
+
+def _propagate_aliases(modules: dict[str, ModuleInfo]) -> None:
+    """``name = other_jitted`` / ``name = mod.jitted`` aliases, to a
+    fixpoint across modules (bounded — chains are short in practice)."""
+    for _ in range(4):
+        changed = False
+        for mi in modules.values():
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                target = node.targets[0]
+                if not isinstance(target, ast.Name) or target.id in mi.jit_names:
+                    continue
+                value = node.value
+                statics = None
+                if isinstance(value, ast.Name) and value.id in mi.jit_names:
+                    statics = mi.jit_names[value.id]
+                elif (
+                    isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id in mi.mod_alias
+                ):
+                    other = modules.get(mi.mod_alias[value.value.id])
+                    if other is not None and value.attr in other.jit_names:
+                        statics = other.jit_names[value.attr]
+                elif isinstance(value, ast.Name) and value.id in mi.sym_alias:
+                    src_rel, sym = mi.sym_alias[value.id]
+                    other = modules.get(src_rel)
+                    if other is not None and sym in other.jit_names:
+                        statics = other.jit_names[sym]
+                if statics is not None:
+                    mi.jit_names[target.id] = set(statics)
+                    changed = True
+        if not changed:
+            return
+
+
+class DeviceIndex:
+    """Cross-module view: jittedness, seam membership, name resolution."""
+
+    def __init__(self, modules: dict[str, ModuleInfo]):
+        self.modules = modules
+
+    def is_jitted(self, rel: str, name: str) -> bool:
+        mi = self.modules.get(rel)
+        return mi is not None and name in mi.jit_names
+
+    def jitted_statics(self, rel: str, name: str) -> set[str]:
+        mi = self.modules.get(rel)
+        if mi is None:
+            return set()
+        return mi.jit_names.get(name, set())
+
+    def seam_globs(self, rel: str) -> list[str]:
+        return [glob for mod, glob in SEAMS if mod == rel]
+
+    def is_seam(self, rel: str, name: str) -> bool:
+        return any(fnmatch.fnmatchcase(name, g) for g in self.seam_globs(rel))
+
+    def resolve(self, mi: ModuleInfo, node: ast.AST) -> tuple[str, str] | None:
+        """(module rel, symbol) a Name/Attribute refers to, through this
+        module's defs and explicit imports; None when unresolvable."""
+        if isinstance(node, ast.Name):
+            if node.id in mi.sym_alias:
+                return mi.sym_alias[node.id]
+            if node.id in mi.jit_names or node.id in mi.func_defs:
+                return (mi.rel, node.id)
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            base = node.value.id
+            if base in mi.mod_alias:
+                return (mi.mod_alias[base], node.attr)
+        return None
+
+
+def build_index(
+    repo_root: Path, sources=None, subdir: str = "lodestar_tpu"
+) -> DeviceIndex | None:
+    """Index every parsable module under ``repo_root/subdir``; None when
+    the tree is absent (fixture repos without a package directory)."""
+    root = Path(repo_root)
+    base = root / subdir
+    if not base.is_dir():
+        return None
+    modules: dict[str, ModuleInfo] = {}
+    for path in iter_py_files([base]):
+        sf = cached_source(sources, path)
+        if sf is None or sf.tree is None:
+            continue
+        rel = path.resolve().relative_to(root.resolve()).as_posix()
+        modules[rel] = ModuleInfo(rel=rel, sf=sf)
+    files = set(modules)
+    for mi in modules.values():
+        _collect_imports(mi, files)
+        _collect_defs_and_jit(mi)
+    _propagate_aliases(modules)
+    return DeviceIndex(modules)

@@ -1,0 +1,217 @@
+"""counted-dispatch: every call of a jit-wrapped callable is reachable
+only through the counted dispatch seams.
+
+The launch-count campaign's whole accounting rests on ONE invariant:
+device programs launch at the counted seams — ``ops/prep._dispatch``,
+``ssz/device_htr._device_level``, ``chain/bls/mesh.mesh_launch``,
+``models/batch_verify.device_batch_verify*`` — where the launch
+counters, ``lodestar_device_launch_seconds`` telemetry, and the
+launch-budget bench gates live. A jitted callable invoked anywhere else
+launches a compiled program the ledger never sees: the dashboard's
+launches-per-batch quotient lies, the budget tests pass while the real
+schedule regresses, and the AOT-bundle plan (which needs dispatch sites
+statically enumerable) silently loses a site.
+
+Enforced as a reference-graph fixpoint over the whole package (the
+PR 7 loop-confined checker's construction, widened cross-module through
+explicit imports):
+
+* A scope is DISCIPLINED when it is a seam function, a trace-time body
+  (jit/vmap-decorated, or registered with a jax transform or a lax
+  control-flow primitive — calls of jitted callables inside another
+  trace are inlining, not dispatches), or a function whose every
+  non-registration reference in the package comes from disciplined
+  scopes. Module-level STORAGE of a callable (the ``_FieldOps``
+  static-argument tables) is not a call and does not poison the
+  fixpoint; module-level CALLS do.
+* A call of a jit-wrapped callable (resolved by name through defs,
+  aliases, and imports — including ``name = jax.jit(...)`` assignments,
+  jit-wrapped lambdas, and stored-then-called aliases) from any
+  UNdisciplined scope, or at module level, is a finding.
+
+Dynamic dispatch (callables in dicts, ``getattr``) is invisible to the
+name-level graph; such sites carry a pragma with the reason, which is
+the documentation they need anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from pathlib import Path
+
+from ..core import Finding, Rule
+from ._device import DeviceIndex, ModuleInfo, build_index, last_segment
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """One module's scopes, references, and jit-call sites."""
+
+    def __init__(self, idx: DeviceIndex, mi: ModuleInfo):
+        self.idx = idx
+        self.mi = mi
+        self.stack: list[ast.AST] = []
+        #: id() of Name/Attribute nodes that are the callee of a Call
+        self.callees: set[int] = set()
+        #: (rel, name) -> [(scope node | None)] non-registration refs
+        self.refs: dict[tuple[str, str], list[ast.AST | None]] = {}
+        #: (call node, (rel, name), scope node | None)
+        self.jit_calls: list[tuple[ast.Call, tuple[str, str], ast.AST | None]] = []
+        #: lambda id -> lexically enclosing scope node
+        self.lambda_parent: dict[int, ast.AST | None] = {}
+
+    def scan(self) -> None:
+        self.visit(self.mi.tree)
+
+    # -- scope tracking --------------------------------------------------------
+
+    def _enter(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Lambda):
+            self.lambda_parent[id(node)] = self.stack[-1] if self.stack else None
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+    visit_Lambda = _enter
+
+    # -- references and call sites --------------------------------------------
+
+    def _current(self) -> ast.AST | None:
+        return self.stack[-1] if self.stack else None
+
+    def _note_ref(self, node: ast.AST) -> None:
+        if id(node) in self.mi.registration_refs:
+            return
+        mi = self.mi
+        target = self.idx.resolve(mi, node)
+        if target is None and isinstance(node, ast.Attribute):
+            # `self.method()` style: name-keyed, like the PR 7 checker
+            if node.attr in mi.func_defs:
+                target = (mi.rel, node.attr)
+        if target is not None and self._known_function(target):
+            if self._current() is None and id(node) not in self.callees:
+                # module-level STORAGE (the _FieldOps static-argument
+                # tables, __all__-adjacent aliases): storing a callable
+                # is not calling it — record the symbol without
+                # poisoning its fixpoint. Calls THROUGH the table are
+                # dynamic dispatch, invisible to the name graph either
+                # way; calls OF jitted names stay caught via aliases.
+                self.refs.setdefault(target, [])
+                return
+            self.refs.setdefault(target, []).append(self._current())
+
+    def _known_function(self, target: tuple[str, str]) -> bool:
+        rel, name = target
+        other = self.idx.modules.get(rel)
+        return other is not None and (
+            name in other.func_defs or name in other.jit_names
+        )
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self._note_ref(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self._note_ref(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.callees.add(id(node.func))
+        target = self.idx.resolve(self.mi, node.func)
+        if target is not None and self._is_jitted(target):
+            self.jit_calls.append((node, target, self._current()))
+        self.generic_visit(node)
+
+    def _is_jitted(self, target: tuple[str, str]) -> bool:
+        rel, name = target
+        return self.idx.is_jitted(rel, name)
+
+
+class CountedDispatchRule(Rule):
+    name = "counted-dispatch"
+    description = (
+        "jit-wrapped callables are only invoked through the counted "
+        "dispatch seams (ops/prep._dispatch, ssz/device_htr._device_level, "
+        "chain/bls/mesh.mesh_launch, models/batch_verify.device_batch_"
+        "verify*) or inside trace-time bodies — uncounted launches are "
+        "invisible to the launch ledger and budget gates"
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        idx = build_index(repo_root, sources)
+        if idx is None:
+            return []
+
+        scans = {rel: _ModuleScan(idx, mi) for rel, mi in idx.modules.items()}
+        for scan in scans.values():
+            scan.scan()
+
+        # disciplined fixpoint: roots are seam defs + trace-time bodies
+        disciplined: set[int] = set()
+        lambda_parent: dict[int, ast.AST | None] = {}
+        for rel, mi in idx.modules.items():
+            lambda_parent.update(scans[rel].lambda_parent)
+            disciplined |= mi.trace_root_defs
+            for glob in idx.seam_globs(rel):
+                for name, fns in mi.func_defs.items():
+                    if fnmatch.fnmatchcase(name, glob):
+                        disciplined.update(id(fn) for fn in fns)
+
+        def scope_ok(scope: ast.AST | None) -> bool:
+            seen = 0
+            while isinstance(scope, ast.Lambda):
+                if id(scope) in disciplined:
+                    return True
+                scope = lambda_parent.get(id(scope))
+                seen += 1
+                if seen > 50:  # defensive: malformed parent chain
+                    return False
+            return scope is not None and id(scope) in disciplined
+
+        refs: dict[tuple[str, str], list[ast.AST | None]] = {}
+        for scan in scans.values():
+            for target, sites in scan.refs.items():
+                refs.setdefault(target, []).extend(sites)
+
+        changed = True
+        while changed:
+            changed = False
+            for (rel, name), sites in refs.items():
+                fns = idx.modules[rel].func_defs.get(name, ())
+                if not fns or all(id(fn) in disciplined for fn in fns):
+                    continue
+                if all(scope_ok(s) for s in sites):
+                    disciplined.update(id(fn) for fn in fns)
+                    changed = True
+
+        seam_list = "ops/prep._dispatch, ssz/device_htr._device_level, " \
+            "chain/bls/mesh.mesh_launch, models/batch_verify.device_batch_verify*"
+        findings: list[Finding] = []
+        for rel, scan in sorted(scans.items()):
+            for call, (tgt_rel, tgt_name), scope in scan.jit_calls:
+                if scope_ok(scope):
+                    continue
+                where = (
+                    "at module level"
+                    if scope is None
+                    else f"in '{getattr(scope, 'name', '<lambda>')}'"
+                )
+                mod = tgt_rel.removesuffix(".py").replace("/", ".")
+                findings.append(
+                    Finding(
+                        self.name,
+                        str(repo_root / rel),
+                        call.lineno,
+                        f"uncounted device dispatch: jit-wrapped "
+                        f"'{mod}.{tgt_name}' called {where}, which is not "
+                        f"reachable only through the counted seams "
+                        f"({seam_list}) — the launch is invisible to the "
+                        "launch counters/telemetry and every launch-budget "
+                        "gate; route it through a counted seam",
+                    )
+                )
+        return findings

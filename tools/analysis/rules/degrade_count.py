@@ -1,0 +1,186 @@
+"""degrade-and-count: every ``except`` wrapping a device dispatch both
+ticks a fallback counter and routes to a named host path.
+
+The degradation chain (single-launch → split schedule → host prep;
+device HTR → CPU hasher; device KZG → CPU oracle) is the reason a
+device fault is an alert, not an outage — but ONLY if every degradation
+is observable and lands somewhere deliberate. An ``except`` around a
+device dispatch that swallows the error silently serves wrong-shaped
+work with no counter movement: the fleet is degraded and every
+dashboard says it is healthy.
+
+For each ``try`` whose body contains a device dispatch — a call
+resolving to a counted seam or a jit-wrapped callable, or a seam/jitted
+callable passed as an argument (the stored-then-dispatched shape, e.g.
+``self._flush_with(_device_level, ...)``) — every handler must either:
+
+* **re-raise** (propagation/conversion is not degradation), or
+* **count AND route**: tick a ``*fallback*`` counter (a call whose
+  dotted name contains "fallback" — ``note_fallback(e)``,
+  ``m.fallbacks.labels(leg).inc()`` — the metrics-wiring rule keeps
+  those families registered and panelled) and hand control to a named
+  host path: a ``return <call>(...)``, a statement call naming a
+  host-ish target (cpu/host/split/oracle/unfused/fallback), or plain
+  fall-through into the code after the ``try`` (the
+  ``build_device_inputs`` shape, where the host path is the next
+  statement).
+
+A handler that counts but dead-ends in ``return None``/``return False``
+is still a finding: the caller can't distinguish "device degraded" from
+a verdict, which is how silent wrong-shape serving starts. ``try``
+blocks inside trace-time bodies are exempt (they run at trace, not at
+dispatch).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..core import Finding, Rule
+from ._device import DeviceIndex, ModuleInfo, build_index, dotted, last_segment
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: tokens that mark a statement call as a named host-path handoff
+HOST_TOKENS = ("cpu", "host", "split", "oracle", "fallback", "unfused")
+
+
+def _resolves_to_dispatch(idx: DeviceIndex, mi: ModuleInfo, node: ast.AST) -> bool:
+    target = idx.resolve(mi, node)
+    if target is None:
+        return False
+    rel, name = target
+    return idx.is_jitted(rel, name) or idx.is_seam(rel, name)
+
+
+def _try_dispatches(idx: DeviceIndex, mi: ModuleInfo, body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if _resolves_to_dispatch(idx, mi, node.func):
+                return True
+            # a seam/jitted callable handed onward as an argument —
+            # the stored-then-dispatched shape
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)) and _resolves_to_dispatch(
+                    idx, mi, arg
+                ):
+                    return True
+    return False
+
+
+def _handler_raises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _handler_counts(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or last_segment(node.func) or ""
+        if "fallback" in name.lower():
+            return True
+        # m.fallbacks.labels("leg").inc(): the receiver chain is a Call,
+        # so dotted() can't see it — stringify the receiver of .inc()
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "inc":
+            recv = node.func.value
+            while isinstance(recv, ast.Call):
+                recv = recv.func
+            if "fallback" in (dotted(recv) or "").lower():
+                return True
+    return False
+
+
+def _handler_routes(handler: ast.ExceptHandler) -> bool:
+    returns = [n for n in ast.walk(handler) if isinstance(n, ast.Return)]
+    if any(isinstance(r.value, ast.Call) for r in returns):
+        return True
+    for stmt in ast.walk(handler):
+        if not isinstance(stmt, (ast.Expr, ast.Assign)):
+            continue
+        value = stmt.value
+        if not isinstance(value, ast.Call):
+            continue
+        names = [dotted(value.func) or ""]
+        names += [
+            dotted(a) or ""
+            for a in list(value.args) + [k.value for k in value.keywords]
+        ]
+        if any(tok in n.lower() for n in names for tok in HOST_TOKENS):
+            return True
+    # no return at all: the handler falls through to the statements
+    # after the try — the host path is the next code to run
+    return not returns
+
+
+class DegradeAndCountRule(Rule):
+    name = "degrade-and-count"
+    description = (
+        "every except wrapping a device dispatch ticks a *fallback* "
+        "counter AND routes to a named host path (or re-raises) — "
+        "silent or uncounted degradation serves wrong-shaped work "
+        "while every dashboard reads healthy"
+    )
+    scope = "project"
+
+    def check_project(self, repo_root: Path, sources=None):
+        idx = build_index(repo_root, sources)
+        if idx is None:
+            return []
+        findings: list[Finding] = []
+        for rel in sorted(idx.modules):
+            mi = idx.modules[rel]
+            # try statements with their innermost enclosing function
+            stack: list[ast.AST] = []
+            trys: list[tuple[ast.Try, ast.AST | None]] = []
+
+            def collect(node: ast.AST) -> None:
+                is_scope = isinstance(node, _SCOPES)
+                if is_scope:
+                    stack.append(node)
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.Try):
+                        trys.append((child, stack[-1] if stack else None))
+                    collect(child)
+                if is_scope:
+                    stack.pop()
+
+            collect(mi.tree)
+
+            for try_node, scope in trys:
+                if scope is not None and id(scope) in mi.trace_root_defs:
+                    continue  # trace-time try: runs at trace, not dispatch
+                if not _try_dispatches(idx, mi, try_node.body):
+                    continue
+                for handler in try_node.handlers:
+                    if _handler_raises(handler):
+                        continue
+                    counts = _handler_counts(handler)
+                    routes = _handler_routes(handler)
+                    if counts and routes:
+                        continue
+                    missing = []
+                    if not counts:
+                        missing.append(
+                            "ticks no *fallback* counter (the degradation "
+                            "is invisible to alerts)"
+                        )
+                    if not routes:
+                        missing.append(
+                            "names no host path (dead-end return instead "
+                            "of a fallback callable or fall-through)"
+                        )
+                    findings.append(
+                        Finding(
+                            self.name,
+                            str(repo_root / rel),
+                            handler.lineno,
+                            "except wraps a device dispatch but "
+                            + " and ".join(missing)
+                            + " — degrade-and-count: count the fallback "
+                            "and route to a named host path, or re-raise",
+                        )
+                    )
+        return findings

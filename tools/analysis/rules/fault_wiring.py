@@ -29,7 +29,7 @@ from __future__ import annotations
 import ast
 from pathlib import Path
 
-from ..core import Finding, Rule
+from ..core import Finding, Rule, cached_source
 
 REGISTRY_REL = Path("lodestar_tpu") / "testing" / "faults.py"
 ENUM_CLASS = "FaultKind"
@@ -105,11 +105,10 @@ class FaultWiringRule(Rule):
     def check_project(self, repo_root: Path, sources=None):
         findings: list[Finding] = []
         registry_path = repo_root / REGISTRY_REL
-        if not registry_path.is_file():
+        registry_sf = cached_source(sources, registry_path)
+        if registry_sf is None or registry_sf.tree is None:
             return findings
-        tree = ast.parse(
-            registry_path.read_text(encoding="utf-8"), filename=str(registry_path)
-        )
+        tree = registry_sf.tree
         cls, members = _enum_members(tree)
         if cls is None or not members:
             findings.append(
@@ -151,16 +150,10 @@ class FaultWiringRule(Rule):
         # 2. consumers -> registry
         values = {val for val, _name in by_value.items()}
         for path in self._consumer_files(repo_root, registry_path):
-            try:
-                text = path.read_text(encoding="utf-8")
-            except OSError:
+            sf = cached_source(sources, path)
+            if sf is None or sf.tree is None or ENUM_CLASS not in sf.text:
                 continue
-            if ENUM_CLASS not in text:
-                continue
-            try:
-                consumer = ast.parse(text, filename=str(path))
-            except SyntaxError:
-                continue
+            consumer = sf.tree
             for name, line in _kind_refs(consumer):
                 if name not in members:
                     findings.append(

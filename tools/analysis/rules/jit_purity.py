@@ -1,0 +1,279 @@
+"""jit-purity: no host-sync or retrace hazards inside jit-traced bodies.
+
+The staged-jit miscompile doctrine as a rule: a jitted body runs ONCE
+per (shape, static-args) key as a trace, so host-side operations inside
+it either force a device round trip mid-program or silently bake a
+trace-time value into the compiled artifact:
+
+* ``.item()`` — blocks on the device and syncs mid-trace; inside a
+  traced body it also means the trace depends on a runtime VALUE, the
+  exact bug class the fused-vs-unfused differential exists to catch.
+* ``int(x)`` / ``float(x)`` / ``bool(x)`` on a traced parameter — a
+  concretization: either a tracer error at first trace, or (through
+  numpy coercion) a value frozen at trace time.
+* ``np.*`` calls FED BY a traced parameter — host numpy inside a trace
+  computes on trace-time values; a result depending on a traced
+  argument is baked into the compiled program and is simply wrong for
+  the next batch. (An np call fed only by constants or static/plain-
+  Python parameters — the static-exponent bit tables — is a legal
+  trace-time constant and stays quiet; parameters annotated
+  ``int``/``float``/``bool``/``str`` are treated as trace-time.)
+* ``if``/``while`` on a traced parameter — Python control flow
+  branches on the TRACER, not the value: ConcretizationError at best, a
+  trace specialized to the first batch at worst. (``is``/``is None``
+  tests are trace-time identity and stay legal; parameters declared in
+  ``static_argnums``/``static_argnames`` are Python values and exempt.)
+* ``range(len(param))`` loops — unrolls the trace over a traced axis:
+  a program whose SIZE depends on the batch, i.e. a compile per length.
+
+Scope: functions decorated with / passed to ``jax.jit`` (including
+``functools.partial(jax.jit, ...)`` and jit-wrapped lambdas) and their
+statically-reachable same-module helpers. Helpers get the host-sync
+checks (``.item()``, ``np.*``); the parameter-flow checks run only on
+the jit roots themselves, where the static-argument declaration is
+visible — a helper's plain-Python flag arguments (trace-time constants)
+must not false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule, SourceFile
+from ._device import is_jit_call, last_segment, static_params
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _numpy_aliases(tree: ast.Module) -> set[str]:
+    """Names bound to HOST numpy (``jax.numpy`` aliases excluded)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _jit_roots(tree: ast.Module) -> dict[int, tuple[ast.AST, set[str]]]:
+    """id -> (def/lambda node, static param names) for every jit root."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _SCOPES):
+            defs.setdefault(node.name, node)
+
+    roots: dict[int, tuple[ast.AST, set[str]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _SCOPES):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and is_jit_call(dec):
+                    roots[id(node)] = (node, static_params(dec, node))
+                elif last_segment(dec) == "jit":
+                    roots[id(node)] = (node, set())
+        elif isinstance(node, ast.Call) and is_jit_call(node):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Lambda):
+                    roots[id(arg)] = (arg, static_params(node, arg))
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    fn = defs[arg.id]
+                    roots.setdefault(id(fn), (fn, static_params(node, fn)))
+                elif isinstance(arg, ast.Call):
+                    # jax.jit(jax.vmap(f)): the innermost named callable
+                    inner = arg.args[0] if arg.args else None
+                    if isinstance(inner, ast.Name) and inner.id in defs:
+                        fn = defs[inner.id]
+                        roots.setdefault(id(fn), (fn, static_params(node, fn)))
+    return roots
+
+
+def _reachable_helpers(
+    tree: ast.Module, roots: dict[int, tuple[ast.AST, set[str]]]
+) -> list[ast.AST]:
+    """Same-module defs referenced (transitively) from a jit root body."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _SCOPES):
+            defs.setdefault(node.name, node)
+    seen: set[int] = set(roots)
+    frontier = [fn for fn, _ in roots.values()]
+    helpers: list[ast.AST] = []
+    while frontier:
+        scope = frontier.pop()
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Name) and node.id in defs:
+                fn = defs[node.id]
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    helpers.append(fn)
+                    frontier.append(fn)
+    return helpers
+
+
+_PLAIN_ANNOTATIONS = {"int", "float", "bool", "str"}
+
+
+def _dynamic_params(fn: ast.AST, statics: set[str]) -> set[str]:
+    a = fn.args
+    params = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+    out: set[str] = set()
+    for p in params:
+        if p.arg in statics or p.arg in ("self", "cls"):
+            continue
+        ann = p.annotation
+        if isinstance(ann, ast.Name) and ann.id in _PLAIN_ANNOTATIONS:
+            continue  # `scalar: int` is a trace-time Python value
+        out.add(p.arg)
+    return out
+
+
+def _bare_dyn_names(node: ast.AST, dyn: set[str]) -> list[ast.Name]:
+    """Dynamic-param Names in `node`, skipping Attribute subtrees
+    (``x.shape``/``x.ndim``/``x.dtype`` are trace-static)."""
+    hits: list[ast.Name] = []
+
+    def walk(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute):
+            return
+        if isinstance(n, ast.Name) and n.id in dyn:
+            hits.append(n)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(node)
+    return hits
+
+
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = (
+        "no host-sync/retrace hazards inside jit-traced bodies: .item(), "
+        "int()/float()/bool() on traced params, host np.* calls, Python "
+        "if/while on traced params, range(len(param)) trace unrolling"
+    )
+
+    def check(self, sf: SourceFile):
+        tree = sf.tree
+        roots = _jit_roots(tree)
+        if not roots:
+            return []
+        np_aliases = _numpy_aliases(tree)
+        helpers = _reachable_helpers(tree, roots)
+        findings: list[Finding] = []
+        flagged: set[tuple[int, str]] = set()
+
+        def flag(node: ast.AST, kind: str, msg: str) -> None:
+            key = (node.lineno, kind)
+            if key not in flagged:
+                flagged.add(key)
+                findings.append(Finding(self.name, sf.path, node.lineno, msg))
+
+        def host_sync_checks(scope: ast.AST, where: str, dyn: set[str]) -> None:
+            for node in ast.walk(scope):
+                if node is not scope and isinstance(node, _SCOPES):
+                    continue  # nested defs are visited as their own helpers
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "item":
+                    flag(
+                        node, "item",
+                        f".item() inside {where} forces a device→host sync "
+                        "mid-trace and bakes a runtime value into the "
+                        "compiled program — keep the value on device or "
+                        "hoist the read outside the jitted body",
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in np_aliases
+                    # constant/static-fed np (bit tables) is a legal
+                    # trace-time constant; the hazard is a traced value
+                    and any(_bare_dyn_names(a, dyn) for a in node.args)
+                ):
+                    flag(
+                        node, "np",
+                        f"host numpy call np.{f.attr}(...) inside {where} "
+                        "is fed by a traced argument — the result is "
+                        "frozen into the compiled program at trace time; "
+                        "use jnp or hoist the computation out of the "
+                        "traced body",
+                    )
+
+        def param_flow_checks(fn: ast.AST, statics: set[str]) -> None:
+            dyn = _dynamic_params(fn, statics)
+            if not dyn:
+                return
+            name = getattr(fn, "name", "<lambda>")
+
+            for node in ast.walk(fn):
+                if node is not fn and isinstance(node, _SCOPES):
+                    continue
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Name)
+                        and f.id in ("int", "float", "bool")
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in dyn
+                    ):
+                        flag(
+                            node, "cast",
+                            f"{f.id}({node.args[0].id}) concretizes a traced "
+                            f"parameter of jitted '{name}' — a tracer error "
+                            "or a value frozen at trace time; compute on "
+                            "device or pass it as a static argument",
+                        )
+                    elif (
+                        isinstance(f, ast.Name)
+                        and f.id == "range"
+                        and any(
+                            isinstance(a, ast.Call)
+                            and isinstance(a.func, ast.Name)
+                            and a.func.id == "len"
+                            and a.args
+                            and isinstance(a.args[0], ast.Name)
+                            and a.args[0].id in dyn
+                            for a in node.args
+                        )
+                    ):
+                        flag(
+                            node, "len-loop",
+                            f"range(len(...)) over a traced parameter of "
+                            f"jitted '{name}' unrolls the trace per batch "
+                            "length — one compile per size; pad to the "
+                            "shared pow-2 size classes or use lax control "
+                            "flow",
+                        )
+                elif isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    if isinstance(test, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+                    ):
+                        continue  # `x is None` is trace-time identity
+                    for hit in _bare_dyn_names(test, dyn):
+                        flag(
+                            node, "branch",
+                            f"Python {'if' if isinstance(node, ast.If) else 'while'} "
+                            f"on traced parameter '{hit.id}' of jitted "
+                            f"'{name}' branches on the tracer, not the "
+                            "value — use jnp.where/lax.cond, or declare "
+                            "the parameter static",
+                        )
+                        break
+
+        for fn, statics in roots.values():
+            host_sync_checks(
+                fn,
+                f"jitted '{getattr(fn, 'name', '<lambda>')}'",
+                _dynamic_params(fn, statics),
+            )
+            param_flow_checks(fn, statics)
+        for fn in helpers:
+            host_sync_checks(
+                fn,
+                f"'{fn.name}' (reached from a jitted body)",
+                _dynamic_params(fn, set()),
+            )
+        return findings

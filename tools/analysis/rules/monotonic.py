@@ -1,14 +1,16 @@
 """monotonic-durations: elapsed-time / deadline math never uses the
-wall clock.
+wall clock, and deterministic-harness code never reads the real clock
+unconditionally.
 
 ``time.time()`` jumps under NTP steps and leap smearing; every duration
 or deadline computed from it is wrong exactly when the machine is
 having a bad day. The rule flags any wall-clock read —
-``time.time()`` through any module alias, or a direct
-``from time import time`` name — that appears inside additive
-arithmetic (``+``/``-``, including augmented assignment) or a
-comparison: that is duration/deadline math and belongs to
-``time.monotonic()`` / ``time.perf_counter()`` /
+``time.time()`` through any module alias, a direct
+``from time import time`` name, or ``datetime.now()`` /
+``datetime.utcnow()`` through any import spelling — that appears
+inside additive arithmetic (``+``/``-``, including augmented
+assignment) or a comparison: that is duration/deadline math and
+belongs to ``time.monotonic()`` / ``time.perf_counter()`` /
 ``time.monotonic_ns()``.
 
 Pure timestamp uses (logging a wall time, persisting an ``at:`` field,
@@ -17,13 +19,34 @@ wall-clock arithmetic — slot math anchored at a protocol
 ``genesis_time``, re-applying a persisted cool-off across restarts —
 is suppressed inline with a reason, which is exactly the documentation
 those sites need anyway.
+
+SimClock-awareness (``testing/`` code only): the deterministic fleet
+harness injects a ``SimClock`` so chaos runs replay byte-identically.
+A bare ``time.time()`` / ``time.monotonic*()`` / ``time.perf_counter*()``
+CALL in harness code silently reintroduces real time into a simulated
+run. The legal idiom guards the real clock behind a clock-is-None
+conditional (``self.clock.time() if self.clock is not None else
+time.time()``) — any real-clock call with an enclosing ``if``/ternary
+whose test mentions a clock is exempt, as is passing the function VALUE
+(``time_fn=time.monotonic_ns``: a reference, not a read). ``clock.py``
+itself (the SimClock implementation) is exempt wholesale.
 """
 
 from __future__ import annotations
 
 import ast
+from pathlib import Path
 
 from ..core import Finding, Rule, SourceFile
+
+#: real-clock readers that bypass an injected SimClock in harness code
+_REAL_CLOCK_FNS = {
+    "time",
+    "monotonic",
+    "monotonic_ns",
+    "perf_counter",
+    "perf_counter_ns",
+}
 
 
 def _wall_clock_names(tree: ast.Module) -> tuple[set[str], set[str]]:
@@ -41,17 +64,61 @@ def _wall_clock_names(tree: ast.Module) -> tuple[set[str], set[str]]:
     return mods, funcs
 
 
+def _datetime_names(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(module aliases of ``datetime``, class aliases of
+    ``datetime.datetime``) — both spellings of now()/utcnow()."""
+    mods: set[str] = set()
+    classes: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "datetime":
+                    mods.add(a.asname or "datetime")
+        elif isinstance(node, ast.ImportFrom) and node.module == "datetime":
+            for a in node.names:
+                if a.name == "datetime":
+                    classes.add(a.asname or "datetime")
+    return mods, classes
+
+
+def _real_clock_funcs(tree: ast.Module) -> set[str]:
+    """Local aliases of ``from time import monotonic/perf_counter/...``
+    — real-clock reads for the SimClock check, but NOT wall-clock reads
+    for the duration check (monotonic arithmetic is the fix, not the
+    bug)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _REAL_CLOCK_FNS:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _mentions_clock(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "clock" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "clock" in sub.attr.lower():
+            return True
+    return False
+
+
 class MonotonicDurationsRule(Rule):
     name = "monotonic-durations"
     description = (
-        "no time.time() in +/- arithmetic or comparisons — use "
-        "time.monotonic()/perf_counter() for durations and deadlines"
+        "no time.time()/datetime.now()/utcnow() in +/- arithmetic or "
+        "comparisons (use time.monotonic()/perf_counter()), and no "
+        "unconditional real-clock reads in testing/ harness code (the "
+        "injected SimClock must stay authoritative)"
     )
 
     def check(self, sf: SourceFile):
         mods, funcs = _wall_clock_names(sf.tree)
+        dt_mods, dt_classes = _datetime_names(sf.tree)
+        real_funcs = funcs | _real_clock_funcs(sf.tree)
         # local `import time` inside functions is caught by the walk too
-        if not mods and not funcs:
+        if not mods and not real_funcs and not dt_mods and not dt_classes:
             return []
         findings: list[Finding] = []
         flagged: set[int] = set()
@@ -67,6 +134,19 @@ class MonotonicDurationsRule(Rule):
                 and fn.value.id in mods
             ):
                 return True
+            if isinstance(fn, ast.Attribute) and fn.attr in ("now", "utcnow"):
+                recv = fn.value
+                # datetime.now() via the class alias
+                if isinstance(recv, ast.Name) and recv.id in dt_classes:
+                    return True
+                # datetime.datetime.now() via the module alias
+                if (
+                    isinstance(recv, ast.Attribute)
+                    and recv.attr == "datetime"
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id in dt_mods
+                ):
+                    return True
             return isinstance(fn, ast.Name) and fn.id in funcs
 
         def flag_calls_in(root: ast.AST) -> None:
@@ -76,9 +156,10 @@ class MonotonicDurationsRule(Rule):
                     findings.append(
                         Finding(
                             MonotonicDurationsRule.name, sf.path, sub.lineno,
-                            "wall-clock time.time() used in elapsed-time/"
-                            "deadline math — use time.monotonic() or "
-                            "perf_counter() (NTP steps corrupt durations)",
+                            "wall-clock read (time.time()/datetime.now()/"
+                            "utcnow()) used in elapsed-time/deadline math — "
+                            "use time.monotonic() or perf_counter() (NTP "
+                            "steps corrupt durations)",
                         )
                     )
 
@@ -92,4 +173,65 @@ class MonotonicDurationsRule(Rule):
                 flag_calls_in(node.value)
             elif isinstance(node, ast.Compare):
                 flag_calls_in(node)
+
+        findings.extend(self._simclock_findings(sf, mods, real_funcs, flagged))
+        return findings
+
+    def _simclock_findings(
+        self,
+        sf: SourceFile,
+        mods: set[str],
+        funcs: set[str],
+        flagged: set[int],
+    ) -> list[Finding]:
+        """Unconditional real-clock CALLS in ``testing/`` harness code."""
+        parts = Path(sf.path).parts
+        if "testing" not in parts or Path(sf.path).name == "clock.py":
+            return []
+
+        def is_real_clock_call(node: ast.AST) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _REAL_CLOCK_FNS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in mods
+            ):
+                return True
+            return isinstance(fn, ast.Name) and fn.id in funcs
+
+        parent: dict[int, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(node):
+                parent[id(child)] = node
+
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not is_real_clock_call(node) or id(node) in flagged:
+                continue
+            # climb: exempt when any enclosing if/ternary tests a clock
+            # (the clock-is-None fallback idiom)
+            guarded = False
+            cur: ast.AST | None = node
+            while cur is not None:
+                p = parent.get(id(cur))
+                if isinstance(p, (ast.If, ast.IfExp)) and _mentions_clock(p.test):
+                    guarded = True
+                    break
+                cur = p
+            if guarded:
+                continue
+            flagged.add(id(node))
+            findings.append(
+                Finding(
+                    self.name, sf.path, node.lineno,
+                    "testing/ harness code reads the real clock "
+                    "unconditionally — consult the injected SimClock and "
+                    "fall back to the real clock only behind a "
+                    "clock-is-None conditional (deterministic replays "
+                    "must not see real time)",
+                )
+            )
         return findings

@@ -50,6 +50,9 @@ UNPANELLED_ALLOWLIST: dict[str, str] = {
     # spans bls_device_launch/bls_buffer_wait carry this decomposition)
     "lodestar_bls_thread_pool_latency_to_worker": "reference-parity placeholder; device pipeline has no worker transfer legs",
     "lodestar_bls_thread_pool_latency_from_worker": "reference-parity placeholder; device pipeline has no worker transfer legs",
+    # KZG is a pre-serving workload today (no blob gossip wired); the
+    # panel lands with the blob-verification dashboard
+    "lodestar_kzg_device_fallback_total": "KZG pre-serving workload; panel lands with the blob-verification dashboard",
     # gossipsub router internals: debug-level detail consumed via logs /
     # ad-hoc queries, not incident dashboards
     "lodestar_gossip_mesh_peers_by_type_count": "gossipsub router debug detail",
